@@ -1,0 +1,384 @@
+"""Typed configuration for megatron_tpu.
+
+Replaces the reference's argparse god-namespace (megatron/arguments.py, 1,103
+LoC; megatron/global_vars.py get_args()) with frozen dataclasses. The CLI
+layer in megatron_tpu/arguments.py maps reference flag names onto these, so
+flag-level parity is preserved without mutable global state.
+
+Field names deliberately follow the reference flags (hidden_size,
+num_attention_heads, ...) so that configs can round-trip through checkpoints
+the way the reference pickles its args namespace
+(ref: megatron/checkpointing.py:267-285).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+# ---------------------------------------------------------------------------
+# enums (ref: megatron/model/enums.py)
+# ---------------------------------------------------------------------------
+
+POSITION_EMBEDDING_TYPES = ("rotary", "absolute")
+NORMALIZATION_TYPES = ("layernorm", "rmsnorm")
+# GLU family per ref megatron/model/glu_activations.py plus plain variants.
+ACTIVATION_TYPES = ("gelu", "geglu", "swiglu", "reglu", "liglu", "relu", "squared_relu")
+GLU_ACTIVATIONS = ("geglu", "swiglu", "reglu", "liglu")
+ATTN_MASK_TYPES = ("causal", "padding", "bidirectional")
+RECOMPUTE_POLICIES = ("none", "selective", "full")
+DTYPES = {"bfloat16": jnp.bfloat16, "float16": jnp.float16, "float32": jnp.float32}
+
+
+def _resolve_dtype(name: str):
+    if name not in DTYPES:
+        raise ValueError(f"unknown dtype {name!r}; one of {sorted(DTYPES)}")
+    return DTYPES[name]
+
+
+# ---------------------------------------------------------------------------
+# model architecture
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Architecture of one decoder-only (or encoder) transformer LM.
+
+    One configurable block covers the union of the reference's model zoo
+    (GPT/Llama/Falcon/Mistral assertion-shell subclasses,
+    ref: megatron/model/{gpt_model,llama_model,falcon_model,mistral_model}.py).
+    Presets live in megatron_tpu/models/presets.py.
+    """
+
+    num_layers: int
+    hidden_size: int
+    num_attention_heads: int
+    vocab_size: int
+    seq_length: int
+
+    # grouped-/multi-query attention (ref: transformer.py:450-465
+    # num_attention_heads_kv broadcast trick). None => MHA.
+    num_kv_heads: Optional[int] = None
+    # head dim override (defaults to hidden_size // num_attention_heads)
+    kv_channels: Optional[int] = None
+    # MLP width. None => 4*hidden for non-GLU, (8/3)*hidden rounded for GLU
+    # presets set it explicitly (e.g. llama-2 7B: 11008).
+    ffn_hidden_size: Optional[int] = None
+
+    # position embeddings (ref: megatron/model/positional_embeddings.py)
+    position_embedding_type: str = "rotary"
+    rope_theta: float = 10000.0
+    # linear position-interpolation RoPE scaling (ref --rope_scaling_factor)
+    rope_scaling_factor: float = 1.0
+    max_position_embeddings: Optional[int] = None  # for absolute pos-emb
+
+    # norms / activations
+    normalization: str = "rmsnorm"
+    layernorm_epsilon: float = 1e-5
+    activation: str = "swiglu"
+    # Falcon-style parallel attention: mlp(ln(x)) + attn(ln(x)) in one
+    # residual add (ref: transformer.py parallel_attn), optionally with a
+    # second dedicated mlp layernorm (Falcon-40B parallel_layernorm).
+    parallel_attn: bool = False
+    parallel_layernorm: bool = False
+    # post-attention norm applied before mlp (standard pre-LN stack)
+
+    # biases (llama/falcon: none; gpt: all)
+    use_bias_linear: bool = False
+    use_bias_qkv: bool = False
+
+    # tied input/output embeddings (gpt/falcon: tied; llama/mistral: untied)
+    tie_embed_logits: bool = False
+
+    # Mistral sliding-window attention (ref: transformer.py:528-536)
+    sliding_window_size: Optional[int] = None
+
+    # regularization
+    hidden_dropout: float = 0.0
+    attention_dropout: float = 0.0
+    # LIMA per-layer linear dropout ramp (ref: transformer.py:994-1001)
+    lima_dropout: bool = False
+
+    # initialization (ref: arguments.py --init_method_std)
+    init_method_std: float = 0.02
+    # scale init of output-facing mats by 1/sqrt(2*num_layers)
+    use_scaled_init: bool = True
+
+    # numerics
+    params_dtype: str = "bfloat16"
+    # compute softmax / norms in fp32 (ref: attention_softmax_in_fp32)
+    softmax_fp32: bool = True
+    attn_mask_type: str = "causal"
+
+    # attention implementation: "pallas" flash kernel with fallback, or
+    # "xla" reference einsum path.
+    attention_impl: str = "xla"
+
+    # ----- derived helpers -------------------------------------------------
+
+    @property
+    def head_dim(self) -> int:
+        return self.kv_channels or self.hidden_size // self.num_attention_heads
+
+    @property
+    def n_kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_attention_heads
+
+    @property
+    def is_glu(self) -> bool:
+        return self.activation in GLU_ACTIVATIONS
+
+    @property
+    def ffn_size(self) -> int:
+        if self.ffn_hidden_size is not None:
+            return self.ffn_hidden_size
+        if self.is_glu:
+            # llama convention: 2/3 * 4h rounded up to multiple of 256
+            raw = int(2 * 4 * self.hidden_size / 3)
+            return 256 * ((raw + 255) // 256)
+        return 4 * self.hidden_size
+
+    @property
+    def dtype(self):
+        return _resolve_dtype(self.params_dtype)
+
+    def validate(self) -> "ModelConfig":
+        if self.position_embedding_type not in POSITION_EMBEDDING_TYPES:
+            raise ValueError(f"bad position_embedding_type {self.position_embedding_type}")
+        if self.normalization not in NORMALIZATION_TYPES:
+            raise ValueError(f"bad normalization {self.normalization}")
+        if self.activation not in ACTIVATION_TYPES:
+            raise ValueError(f"bad activation {self.activation}")
+        if self.attn_mask_type not in ATTN_MASK_TYPES:
+            raise ValueError(f"bad attn_mask_type {self.attn_mask_type}")
+        if self.hidden_size % self.num_attention_heads and self.kv_channels is None:
+            raise ValueError("num_attention_heads must divide hidden_size")
+        if self.num_attention_heads % self.n_kv_heads:
+            raise ValueError("num_attention_heads must be divisible by num_kv_heads")
+        if self.position_embedding_type == "absolute" and not self.max_position_embeddings:
+            raise ValueError("absolute position embeddings need max_position_embeddings")
+        if self.parallel_layernorm and not self.parallel_attn:
+            raise ValueError("parallel_layernorm requires parallel_attn")
+        return self
+
+    # FLOPs per token for one fwd pass, used for MFU accounting
+    # (ref formula: megatron/model/language_model.py:370-384).
+    def flops_per_token_fwd(self, seq_length: Optional[int] = None) -> float:
+        s = seq_length or self.seq_length
+        h, hd = self.hidden_size, self.head_dim
+        nq, nkv = self.num_attention_heads, self.n_kv_heads
+        f = self.ffn_size
+        per_layer = 0.0
+        per_layer += 2 * h * (nq + 2 * nkv) * hd        # qkv proj
+        per_layer += 2 * 2 * s * nq * hd                # qk^T and av (causal ~ /2 but count full)
+        per_layer += 2 * nq * hd * h                    # out proj
+        mlp_in_width = f * (2 if self.is_glu else 1)
+        per_layer += 2 * h * mlp_in_width + 2 * f * h   # mlp
+        total = self.num_layers * per_layer
+        total += 2 * h * self.vocab_size                # logits
+        return float(total)
+
+
+# ---------------------------------------------------------------------------
+# parallel topology
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ParallelConfig:
+    """Parallel topology over one device mesh.
+
+    Replaces the reference's process-group builder
+    (megatron/core/parallel_state.py:51-199). Mesh axis order is
+    ("data", "pipe", "context", "tensor"); tensor is the fastest-varying
+    axis so TP collectives ride the innermost ICI links, matching the
+    reference's TP-innermost-contiguous rank layout
+    (parallel_state.py:68-82 docstring).
+    """
+
+    tensor_parallel: int = 1
+    pipeline_parallel: int = 1
+    # context/sequence-dimension sharding with ring attention — the
+    # long-context axis (beyond reference parity; ref has only
+    # Korthikanti-style SP, see SURVEY.md §2.2).
+    context_parallel: int = 1
+    # data_parallel: None => derived from device count
+    data_parallel: Optional[int] = None
+    # Korthikanti sequence parallelism: shard residual-stream activations
+    # along seq over the *tensor* axis outside matmul blocks
+    # (ref: layers.py:225-236,285-296,691-692).
+    sequence_parallel: bool = False
+    # number of virtual-pipeline chunks per stage (interleaved 1F1B),
+    # ref: schedules.py:253-502. None => non-interleaved.
+    virtual_pipeline_parallel: Optional[int] = None
+
+    def derive_data_parallel(self, n_devices: int) -> int:
+        model_devices = self.tensor_parallel * self.pipeline_parallel * self.context_parallel
+        if n_devices % model_devices:
+            raise ValueError(
+                f"{n_devices} devices not divisible by tp*pp*cp={model_devices}")
+        dp = n_devices // model_devices
+        if self.data_parallel is not None and self.data_parallel != dp:
+            raise ValueError(
+                f"data_parallel={self.data_parallel} inconsistent with "
+                f"{n_devices} devices / (tp*pp*cp={model_devices})")
+        return dp
+
+    def validate(self) -> "ParallelConfig":
+        for name in ("tensor_parallel", "pipeline_parallel", "context_parallel"):
+            if getattr(self, name) < 1:
+                raise ValueError(f"{name} must be >= 1")
+        if self.virtual_pipeline_parallel is not None:
+            if self.pipeline_parallel < 2:
+                raise ValueError("interleaved schedule needs pipeline_parallel >= 2")
+            if self.virtual_pipeline_parallel < 2:
+                raise ValueError("virtual_pipeline_parallel must be >= 2")
+        if self.sequence_parallel and self.tensor_parallel == 1:
+            # ref disables SP when tp==1 (arguments.py:331-341)
+            return dataclasses.replace(self, sequence_parallel=False)
+        return self
+
+
+# ---------------------------------------------------------------------------
+# optimizer / schedule
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class OptimizerConfig:
+    """Adam/SGD + lr schedule + mixed-precision policy.
+
+    Mirrors megatron/optimizer/* and megatron/optimizer_param_scheduler.py.
+    fp32 master weights and fp32 grad accumulation are the default, like the
+    reference's bf16 path (arguments.py: bf16 => accumulate_allreduce_grads_in_fp32).
+    """
+
+    optimizer: str = "adam"
+    lr: float = 3e-4
+    min_lr: float = 0.0
+    lr_decay_style: str = "cosine"  # constant | linear | cosine | inverse-square-root
+    lr_decay_iters: Optional[int] = None
+    lr_warmup_iters: int = 0
+    lr_warmup_fraction: Optional[float] = None
+
+    adam_beta1: float = 0.9
+    adam_beta2: float = 0.999
+    adam_eps: float = 1e-8
+    sgd_momentum: float = 0.9
+    weight_decay: float = 0.01
+    # weight-decay ramp (ref: start_weight_decay/end_weight_decay/incr style)
+    start_weight_decay: Optional[float] = None
+    end_weight_decay: Optional[float] = None
+    weight_decay_incr_style: str = "constant"  # constant | linear | cosine
+
+    clip_grad: float = 1.0
+    # ZeRO-1: shard optimizer state over the data axis
+    # (ref: megatron/optimizer/distrib_optimizer.py, 700 LoC -> sharding specs)
+    use_distributed_optimizer: bool = False
+    # keep fp32 master params for bf16/fp16 training
+    # (ref: Float16OptimizerWithFloat16Params, optimizer.py:508-563)
+    fp32_master_weights: bool = True
+    # dynamic loss scaling for fp16 (never needed for bf16)
+    loss_scale: Optional[float] = None  # None => dynamic when fp16
+    initial_loss_scale: float = 2.0**32
+    min_loss_scale: float = 1.0
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    log_num_zeros_in_grad: bool = False
+
+
+@dataclass(frozen=True)
+class TrainingConfig:
+    """Top-level run config: batching, duration, recompute, checkpoints.
+
+    Mirrors the 'training' / 'checkpointing' / 'mixed precision' argument
+    groups (megatron/arguments.py).
+    """
+
+    micro_batch_size: int = 1
+    global_batch_size: int = 1
+    # batch-size rampup: (start_batch, increment, ramp_samples)
+    # (ref: megatron/microbatches.py RampupBatchsizeNumMicroBatches)
+    rampup_batch_size: Optional[Tuple[int, int, int]] = None
+    train_iters: Optional[int] = None
+    train_samples: Optional[int] = None
+    eval_interval: int = 1000
+    eval_iters: int = 100
+    seed: int = 1234
+    # per-pipeline-stage seed offset policy (ref: initialize.py:179-193)
+    seed_pipeline_offset: int = 100
+    data_parallel_random_init: bool = False
+
+    # activation recompute (ref: transformer.py:1110-1176)
+    recompute_granularity: str = "none"  # none | selective | full
+
+    # checkpointing
+    save: Optional[str] = None
+    load: Optional[str] = None
+    save_interval: Optional[int] = None
+    exit_interval: Optional[int] = None
+    exit_duration_in_mins: Optional[int] = None
+    finetune: bool = False
+    no_load_optim: bool = False
+    no_load_rng: bool = False
+
+    # logging
+    log_interval: int = 100
+    tensorboard_dir: Optional[str] = None
+    wandb_logger: bool = False
+    timing_log_level: int = 0
+
+    # loss averaging for instruction tuning (ref finetune.py scalar_loss_mask)
+    scalar_loss_mask: float = 0.0
+    variable_seq_lengths: bool = False
+
+    def num_microbatches(self, global_batch: Optional[int], data_parallel: int) -> int:
+        gbs = global_batch or self.global_batch_size
+        denom = self.micro_batch_size * data_parallel
+        if gbs % denom:
+            raise ValueError(
+                f"global batch {gbs} not divisible by micro_batch*dp={denom}")
+        return gbs // denom
+
+    def validate(self) -> "TrainingConfig":
+        if self.recompute_granularity not in RECOMPUTE_POLICIES:
+            raise ValueError(f"bad recompute_granularity {self.recompute_granularity}")
+        if self.train_iters is None and self.train_samples is None:
+            pass  # inference / tooling use
+        return self
+
+
+# ---------------------------------------------------------------------------
+# convenience bundle
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class RunConfig:
+    model: ModelConfig
+    parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
+    training: TrainingConfig = field(default_factory=TrainingConfig)
+
+    def validate(self) -> "RunConfig":
+        self.model.validate()
+        self.parallel.validate()
+        self.training.validate()
+        return self
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(d: dict) -> "RunConfig":
+        return RunConfig(
+            model=ModelConfig(**d["model"]),
+            parallel=ParallelConfig(**d["parallel"]),
+            optimizer=OptimizerConfig(**d["optimizer"]),
+            training=TrainingConfig(**{k: (tuple(v) if k == "rampup_batch_size" and v else v)
+                                       for k, v in d["training"].items()}),
+        )

@@ -1,0 +1,11 @@
+from megatron_tpu.interop.hf import (
+    config_from_hf,
+    hf_state_dict_to_params,
+    params_to_hf_state_dict,
+)
+
+__all__ = [
+    "config_from_hf",
+    "hf_state_dict_to_params",
+    "params_to_hf_state_dict",
+]

@@ -1,0 +1,381 @@
+"""HuggingFace weight interop, both directions.
+
+Equivalent of weights_conversion/hf_to_megatron.py (449 LoC) and
+megatron_to_hf.py (621 LoC). Two deliberate simplifications vs the
+reference:
+
+  * No QKV permutation: the reference must interleave HF q/k/v rows into
+    its complex-pair RoPE layout (weights_conversion/utils/permute_qkv.py);
+    we use HF's rotate-half RoPE convention natively, so q/k/v weights map
+    by transpose only.
+  * No resharding tool-chain: params convert to/from a *logical* (unsharded)
+    tree; placement is a separate concern handled by sharding specs, so the
+    reference's tools/checkpoint_util.py loader/saver plugin protocol
+    (907 LoC) has no equivalent to need.
+
+All mappings operate on numpy arrays keyed by HF state-dict names; torch is
+only touched to read/write HF checkpoints at the edges.
+
+Supported architectures: llama (v1/v2/codellama), mistral, falcon (7B/40B),
+gpt2.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from megatron_tpu.config import ModelConfig
+from megatron_tpu.models.params import param_shapes
+
+
+def _to_numpy(t) -> np.ndarray:
+    if isinstance(t, np.ndarray):
+        return t
+    # torch tensor (possibly bf16)
+    import torch
+
+    if t.dtype == torch.bfloat16:
+        t = t.float()
+    return t.detach().cpu().numpy()
+
+
+def _nest_set(tree: Dict[str, Any], path: str, value: np.ndarray) -> None:
+    parts = path.split("/")
+    node = tree
+    for p in parts[:-1]:
+        node = node.setdefault(p, {})
+    node[parts[-1]] = value
+
+
+# ---------------------------------------------------------------------------
+# architecture detection / config mapping
+# ---------------------------------------------------------------------------
+
+
+def config_from_hf(hf_config, seq_length: int = None) -> ModelConfig:
+    """Build a ModelConfig from a transformers PretrainedConfig."""
+    mt = hf_config.model_type
+    if mt in ("llama", "mistral"):
+        rope_scaling = getattr(hf_config, "rope_scaling", None) or {}
+        if rope_scaling and rope_scaling.get("rope_type", rope_scaling.get("type")) != "linear":
+            raise ValueError(f"unsupported rope_scaling {rope_scaling!r} (only linear)")
+        return ModelConfig(
+            num_layers=hf_config.num_hidden_layers,
+            hidden_size=hf_config.hidden_size,
+            num_attention_heads=hf_config.num_attention_heads,
+            num_kv_heads=getattr(hf_config, "num_key_value_heads", None),
+            ffn_hidden_size=hf_config.intermediate_size,
+            vocab_size=hf_config.vocab_size,
+            seq_length=seq_length or hf_config.max_position_embeddings,
+            normalization="rmsnorm",
+            activation="swiglu",
+            position_embedding_type="rotary",
+            rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+            rope_scaling_factor=float(rope_scaling.get("factor", 1.0)),
+            layernorm_epsilon=hf_config.rms_norm_eps,
+            tie_embed_logits=getattr(hf_config, "tie_word_embeddings", False),
+            sliding_window_size=getattr(hf_config, "sliding_window", None)
+            if mt == "mistral" else None,
+        ).validate()
+    if mt == "falcon":
+        new_arch = getattr(hf_config, "new_decoder_architecture", False)
+        nkv = (hf_config.num_kv_heads if new_arch
+               else (1 if getattr(hf_config, "multi_query", True)
+                     else hf_config.num_attention_heads))
+        return ModelConfig(
+            num_layers=hf_config.num_hidden_layers,
+            hidden_size=hf_config.hidden_size,
+            num_attention_heads=hf_config.num_attention_heads,
+            num_kv_heads=nkv,
+            ffn_hidden_size=4 * hf_config.hidden_size,
+            vocab_size=hf_config.vocab_size,
+            seq_length=seq_length or 2048,
+            normalization="layernorm",
+            activation="gelu",
+            position_embedding_type="rotary",
+            parallel_attn=getattr(hf_config, "parallel_attn", True),
+            parallel_layernorm=new_arch,
+            tie_embed_logits=True,
+            layernorm_epsilon=hf_config.layer_norm_epsilon,
+        ).validate()
+    if mt == "gpt2":
+        return ModelConfig(
+            num_layers=hf_config.n_layer,
+            hidden_size=hf_config.n_embd,
+            num_attention_heads=hf_config.n_head,
+            ffn_hidden_size=4 * hf_config.n_embd,
+            vocab_size=hf_config.vocab_size,
+            seq_length=seq_length or hf_config.n_positions,
+            max_position_embeddings=hf_config.n_positions,
+            normalization="layernorm",
+            activation="gelu",
+            position_embedding_type="absolute",
+            use_bias_linear=True,
+            use_bias_qkv=True,
+            tie_embed_logits=True,
+            layernorm_epsilon=hf_config.layer_norm_epsilon,
+        ).validate()
+    raise ValueError(f"unsupported HF model_type {mt!r}")
+
+
+# ---------------------------------------------------------------------------
+# HF -> native
+# ---------------------------------------------------------------------------
+
+
+def _stack(layers, path_fmt, num_layers, transform=lambda x: x):
+    return np.stack([transform(_to_numpy(layers[path_fmt.format(i)]))
+                     for i in range(num_layers)])
+
+
+def _llama_to_params(sd: Dict[str, Any], cfg: ModelConfig) -> Dict[str, Any]:
+    L = cfg.num_layers
+    T = lambda x: np.ascontiguousarray(x.T)
+    p: Dict[str, Any] = {}
+    _nest_set(p, "embed/tokens", _to_numpy(sd["model.embed_tokens.weight"]))
+    _nest_set(p, "layers/ln1/scale",
+              _stack(sd, "model.layers.{}.input_layernorm.weight", L))
+    _nest_set(p, "layers/ln2/scale",
+              _stack(sd, "model.layers.{}.post_attention_layernorm.weight", L))
+    _nest_set(p, "layers/attn/wq",
+              _stack(sd, "model.layers.{}.self_attn.q_proj.weight", L, T))
+    _nest_set(p, "layers/attn/wk",
+              _stack(sd, "model.layers.{}.self_attn.k_proj.weight", L, T))
+    _nest_set(p, "layers/attn/wv",
+              _stack(sd, "model.layers.{}.self_attn.v_proj.weight", L, T))
+    _nest_set(p, "layers/attn/wo",
+              _stack(sd, "model.layers.{}.self_attn.o_proj.weight", L, T))
+    w_in = np.concatenate([
+        _stack(sd, "model.layers.{}.mlp.gate_proj.weight", L, T),
+        _stack(sd, "model.layers.{}.mlp.up_proj.weight", L, T),
+    ], axis=-1)
+    _nest_set(p, "layers/mlp/w_in", w_in)
+    _nest_set(p, "layers/mlp/w_out",
+              _stack(sd, "model.layers.{}.mlp.down_proj.weight", L, T))
+    _nest_set(p, "final_ln/scale", _to_numpy(sd["model.norm.weight"]))
+    if not cfg.tie_embed_logits:
+        _nest_set(p, "lm_head/w", T(_to_numpy(sd["lm_head.weight"])))
+    return p
+
+
+def _split_falcon_qkv(fused: np.ndarray, cfg: ModelConfig):
+    """Falcon fuses qkv grouped per kv head:
+    [(q_0..q_{g-1}, k, v) x n_kv_heads] along the output dim."""
+    h = cfg.hidden_size
+    D = cfg.head_dim
+    nq, nkv = cfg.num_attention_heads, cfg.n_kv_heads
+    g = nq // nkv
+    w = fused.reshape(nkv, g + 2, D, h)
+    q = w[:, :g].reshape(nq * D, h)
+    k = w[:, g].reshape(nkv * D, h)
+    v = w[:, g + 1].reshape(nkv * D, h)
+    T = lambda x: np.ascontiguousarray(x.T)
+    return T(q), T(k), T(v)
+
+
+def _falcon_to_params(sd: Dict[str, Any], cfg: ModelConfig) -> Dict[str, Any]:
+    L = cfg.num_layers
+    T = lambda x: np.ascontiguousarray(x.T)
+    p: Dict[str, Any] = {}
+    _nest_set(p, "embed/tokens", _to_numpy(sd["transformer.word_embeddings.weight"]))
+    if cfg.parallel_layernorm:
+        ln_attn, ln_mlp = "ln_attn", "ln_mlp"
+    else:
+        ln_attn, ln_mlp = "input_layernorm", None
+    _nest_set(p, "layers/ln1/scale",
+              _stack(sd, "transformer.h.{}.%s.weight" % ln_attn, L))
+    _nest_set(p, "layers/ln1/bias",
+              _stack(sd, "transformer.h.{}.%s.bias" % ln_attn, L))
+    if ln_mlp:
+        _nest_set(p, "layers/ln_mlp/scale",
+                  _stack(sd, "transformer.h.{}.%s.weight" % ln_mlp, L))
+        _nest_set(p, "layers/ln_mlp/bias",
+                  _stack(sd, "transformer.h.{}.%s.bias" % ln_mlp, L))
+    qs, ks, vs = [], [], []
+    for i in range(L):
+        fused = _to_numpy(sd[f"transformer.h.{i}.self_attention.query_key_value.weight"])
+        q, k, v = _split_falcon_qkv(fused, cfg)
+        qs.append(q); ks.append(k); vs.append(v)
+    _nest_set(p, "layers/attn/wq", np.stack(qs))
+    _nest_set(p, "layers/attn/wk", np.stack(ks))
+    _nest_set(p, "layers/attn/wv", np.stack(vs))
+    _nest_set(p, "layers/attn/wo",
+              _stack(sd, "transformer.h.{}.self_attention.dense.weight", L, T))
+    _nest_set(p, "layers/mlp/w_in",
+              _stack(sd, "transformer.h.{}.mlp.dense_h_to_4h.weight", L, T))
+    _nest_set(p, "layers/mlp/w_out",
+              _stack(sd, "transformer.h.{}.mlp.dense_4h_to_h.weight", L, T))
+    _nest_set(p, "final_ln/scale", _to_numpy(sd["transformer.ln_f.weight"]))
+    _nest_set(p, "final_ln/bias", _to_numpy(sd["transformer.ln_f.bias"]))
+    return p
+
+
+def _gpt2_to_params(sd: Dict[str, Any], cfg: ModelConfig) -> Dict[str, Any]:
+    L = cfg.num_layers
+    h = cfg.hidden_size
+    p: Dict[str, Any] = {}
+    # HF GPT2 Conv1D stores weights as [in, out] already
+    wte = _to_numpy(sd["transformer.wte.weight"])
+    if wte.shape[0] < cfg.vocab_size:  # pad vocab (50257 -> 50304)
+        pad = np.zeros((cfg.vocab_size - wte.shape[0], h), wte.dtype)
+        wte = np.concatenate([wte, pad], 0)
+    _nest_set(p, "embed/tokens", wte)
+    _nest_set(p, "embed/pos", _to_numpy(sd["transformer.wpe.weight"]))
+    _nest_set(p, "layers/ln1/scale", _stack(sd, "transformer.h.{}.ln_1.weight", L))
+    _nest_set(p, "layers/ln1/bias", _stack(sd, "transformer.h.{}.ln_1.bias", L))
+    _nest_set(p, "layers/ln2/scale", _stack(sd, "transformer.h.{}.ln_2.weight", L))
+    _nest_set(p, "layers/ln2/bias", _stack(sd, "transformer.h.{}.ln_2.bias", L))
+    qkv_w = _stack(sd, "transformer.h.{}.attn.c_attn.weight", L)   # [L, h, 3h]
+    qkv_b = _stack(sd, "transformer.h.{}.attn.c_attn.bias", L)     # [L, 3h]
+    wq, wk, wv = np.split(qkv_w, 3, axis=-1)
+    bq, bk, bv = np.split(qkv_b, 3, axis=-1)
+    for name, val in [("wq", wq), ("wk", wk), ("wv", wv),
+                      ("bq", bq), ("bk", bk), ("bv", bv)]:
+        _nest_set(p, f"layers/attn/{name}", val)
+    _nest_set(p, "layers/attn/wo", _stack(sd, "transformer.h.{}.attn.c_proj.weight", L))
+    _nest_set(p, "layers/attn/bo", _stack(sd, "transformer.h.{}.attn.c_proj.bias", L))
+    _nest_set(p, "layers/mlp/w_in", _stack(sd, "transformer.h.{}.mlp.c_fc.weight", L))
+    _nest_set(p, "layers/mlp/b_in", _stack(sd, "transformer.h.{}.mlp.c_fc.bias", L))
+    _nest_set(p, "layers/mlp/w_out", _stack(sd, "transformer.h.{}.mlp.c_proj.weight", L))
+    _nest_set(p, "layers/mlp/b_out", _stack(sd, "transformer.h.{}.mlp.c_proj.bias", L))
+    _nest_set(p, "final_ln/scale", _to_numpy(sd["transformer.ln_f.weight"]))
+    _nest_set(p, "final_ln/bias", _to_numpy(sd["transformer.ln_f.bias"]))
+    return p
+
+
+_IMPORTERS = {
+    "llama": _llama_to_params,
+    "mistral": _llama_to_params,
+    "falcon": _falcon_to_params,
+    "gpt2": _gpt2_to_params,
+}
+
+
+def hf_state_dict_to_params(
+    sd: Dict[str, Any], cfg: ModelConfig, model_type: str, dtype=None,
+) -> Dict[str, Any]:
+    """Convert an HF state dict to the native param tree (numpy arrays).
+
+    Validates every array against the canonical shape table
+    (models/params.py) — the moral equivalent of the reference's conversion
+    asserting checkpoint layout.
+    """
+    import jax.numpy as jnp
+
+    if model_type not in _IMPORTERS:
+        raise ValueError(f"unsupported model_type {model_type!r}")
+    p = _IMPORTERS[model_type](sd, cfg)
+    shapes = param_shapes(cfg)
+    import jax
+
+    flat_p = dict(_flatten(p))
+    flat_s = dict(_flatten(shapes))
+    if set(flat_p) != set(flat_s):
+        missing = set(flat_s) - set(flat_p)
+        extra = set(flat_p) - set(flat_s)
+        raise ValueError(f"param tree mismatch: missing={missing} extra={extra}")
+    out = {}
+    np_dtype = np.dtype(jnp.dtype(dtype)) if dtype is not None else None
+    for k, v in flat_p.items():
+        want = flat_s[k].shape
+        if tuple(v.shape) != tuple(want):
+            raise ValueError(f"{k}: shape {v.shape} != expected {want}")
+        _nest_set(out, k, v.astype(np_dtype) if np_dtype is not None else v)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# native -> HF
+# ---------------------------------------------------------------------------
+
+
+def _flatten(tree: Dict[str, Any], prefix: str = ""):
+    for k, v in tree.items():
+        path = f"{prefix}/{k}" if prefix else k
+        if isinstance(v, dict):
+            yield from _flatten(v, path)
+        else:
+            yield path, v
+
+
+def params_to_hf_state_dict(
+    params: Dict[str, Any], cfg: ModelConfig, model_type: str,
+) -> Dict[str, np.ndarray]:
+    """Inverse conversion (ref: weights_conversion/megatron_to_hf.py)."""
+    f = {k: np.asarray(v) for k, v in _flatten(params)}
+    L = cfg.num_layers
+    sd: Dict[str, np.ndarray] = {}
+    T = lambda x: np.ascontiguousarray(x.T)
+    if model_type in ("llama", "mistral"):
+        sd["model.embed_tokens.weight"] = f["embed/tokens"]
+        for i in range(L):
+            pre = f"model.layers.{i}"
+            sd[f"{pre}.input_layernorm.weight"] = f["layers/ln1/scale"][i]
+            sd[f"{pre}.post_attention_layernorm.weight"] = f["layers/ln2/scale"][i]
+            sd[f"{pre}.self_attn.q_proj.weight"] = T(f["layers/attn/wq"][i])
+            sd[f"{pre}.self_attn.k_proj.weight"] = T(f["layers/attn/wk"][i])
+            sd[f"{pre}.self_attn.v_proj.weight"] = T(f["layers/attn/wv"][i])
+            sd[f"{pre}.self_attn.o_proj.weight"] = T(f["layers/attn/wo"][i])
+            w_in = f["layers/mlp/w_in"][i]
+            gate, up = np.split(w_in, 2, axis=-1)
+            sd[f"{pre}.mlp.gate_proj.weight"] = T(gate)
+            sd[f"{pre}.mlp.up_proj.weight"] = T(up)
+            sd[f"{pre}.mlp.down_proj.weight"] = T(f["layers/mlp/w_out"][i])
+        sd["model.norm.weight"] = f["final_ln/scale"]
+        if not cfg.tie_embed_logits:
+            sd["lm_head.weight"] = T(f["lm_head/w"])
+        return sd
+    if model_type == "falcon":
+        sd["transformer.word_embeddings.weight"] = f["embed/tokens"]
+        D, nq, nkv = cfg.head_dim, cfg.num_attention_heads, cfg.n_kv_heads
+        g = nq // nkv
+        h = cfg.hidden_size
+        for i in range(L):
+            pre = f"transformer.h.{i}"
+            if cfg.parallel_layernorm:
+                sd[f"{pre}.ln_attn.weight"] = f["layers/ln1/scale"][i]
+                sd[f"{pre}.ln_attn.bias"] = f["layers/ln1/bias"][i]
+                sd[f"{pre}.ln_mlp.weight"] = f["layers/ln_mlp/scale"][i]
+                sd[f"{pre}.ln_mlp.bias"] = f["layers/ln_mlp/bias"][i]
+            else:
+                sd[f"{pre}.input_layernorm.weight"] = f["layers/ln1/scale"][i]
+                sd[f"{pre}.input_layernorm.bias"] = f["layers/ln1/bias"][i]
+            q = T(f["layers/attn/wq"][i]).reshape(nkv, g, D, h)
+            k = T(f["layers/attn/wk"][i]).reshape(nkv, 1, D, h)
+            v = T(f["layers/attn/wv"][i]).reshape(nkv, 1, D, h)
+            fused = np.concatenate([q, k, v], axis=1).reshape((nq + 2 * nkv) * D, h)
+            sd[f"{pre}.self_attention.query_key_value.weight"] = fused
+            sd[f"{pre}.self_attention.dense.weight"] = T(f["layers/attn/wo"][i])
+            sd[f"{pre}.mlp.dense_h_to_4h.weight"] = T(f["layers/mlp/w_in"][i])
+            sd[f"{pre}.mlp.dense_4h_to_h.weight"] = T(f["layers/mlp/w_out"][i])
+        sd["transformer.ln_f.weight"] = f["final_ln/scale"]
+        sd["transformer.ln_f.bias"] = f["final_ln/bias"]
+        sd["lm_head.weight"] = f["embed/tokens"]
+        return sd
+    if model_type == "gpt2":
+        sd["transformer.wte.weight"] = f["embed/tokens"]
+        sd["transformer.wpe.weight"] = f["embed/pos"]
+        for i in range(L):
+            pre = f"transformer.h.{i}"
+            sd[f"{pre}.ln_1.weight"] = f["layers/ln1/scale"][i]
+            sd[f"{pre}.ln_1.bias"] = f["layers/ln1/bias"][i]
+            sd[f"{pre}.ln_2.weight"] = f["layers/ln2/scale"][i]
+            sd[f"{pre}.ln_2.bias"] = f["layers/ln2/bias"][i]
+            sd[f"{pre}.attn.c_attn.weight"] = np.concatenate(
+                [f["layers/attn/wq"][i], f["layers/attn/wk"][i],
+                 f["layers/attn/wv"][i]], axis=-1)
+            sd[f"{pre}.attn.c_attn.bias"] = np.concatenate(
+                [f["layers/attn/bq"][i], f["layers/attn/bk"][i],
+                 f["layers/attn/bv"][i]], axis=-1)
+            sd[f"{pre}.attn.c_proj.weight"] = f["layers/attn/wo"][i]
+            sd[f"{pre}.attn.c_proj.bias"] = f["layers/attn/bo"][i]
+            sd[f"{pre}.mlp.c_fc.weight"] = f["layers/mlp/w_in"][i]
+            sd[f"{pre}.mlp.c_fc.bias"] = f["layers/mlp/b_in"][i]
+            sd[f"{pre}.mlp.c_proj.weight"] = f["layers/mlp/w_out"][i]
+            sd[f"{pre}.mlp.c_proj.bias"] = f["layers/mlp/b_out"][i]
+        sd["transformer.ln_f.weight"] = f["final_ln/scale"]
+        sd["transformer.ln_f.bias"] = f["final_ln/bias"]
+        return sd
+    raise ValueError(f"unsupported model_type {model_type!r}")

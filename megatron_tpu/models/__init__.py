@@ -1,0 +1,12 @@
+from megatron_tpu.models.params import init_params, param_specs, param_shapes
+from megatron_tpu.models.language_model import lm_forward, lm_loss
+from megatron_tpu.models import presets
+
+__all__ = [
+    "init_params",
+    "param_specs",
+    "param_shapes",
+    "lm_forward",
+    "lm_loss",
+    "presets",
+]

@@ -1,0 +1,176 @@
+"""Full language model: embedding -> scanned decoder stack -> logits/loss.
+
+Equivalent of megatron/model/language_model.py (TransformerLanguageModel,
+Embedding, parallel_lm_logits) + megatron/model/gpt_model.py
+(post_language_model_processing). Differences by design:
+
+  * The layer stack is a lax.scan over stacked params — compile time does
+    not grow with depth, and activation recompute is one jax.checkpoint
+    policy on the scan body instead of the reference's
+    distribute_saved_activations machinery
+    (megatron/core/tensor_parallel/random.py:196-248,
+    transformer.py:1110-1176).
+  * Vocab-parallel logits + cross-entropy are plain expressions; sharding
+    specs make them "parallel" (ref: language_model.py:24-53
+    parallel_lm_logits, cross_entropy.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from megatron_tpu.config import ModelConfig
+from megatron_tpu.models.transformer import Sharder, _dropout, _identity_sharder, block_forward
+from megatron_tpu.ops.cross_entropy import cross_entropy_loss
+from megatron_tpu.ops.normalization import norm_forward
+from megatron_tpu.ops.rotary import precompute_rope
+
+
+def _remat_policy(recompute: str):
+    if recompute == "none":
+        return None
+    if recompute == "full":
+        return jax.checkpoint_policies.nothing_saveable
+    if recompute == "selective":
+        # save weight-matmul outputs, recompute core attention — the TPU
+        # expression of the reference's selective recompute
+        # (transformer.py:391-410 checkpointed core attention)
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    raise ValueError(f"unknown recompute policy {recompute!r}")
+
+
+def _layer_dropout_rates(cfg: ModelConfig) -> jnp.ndarray:
+    """Per-layer hidden-dropout rates; LIMA ramps linearly from 0 at the
+    first layer to hidden_dropout at the last (ref transformer.py:994-1001)."""
+    L = cfg.num_layers
+    if cfg.lima_dropout and L > 1:
+        return cfg.hidden_dropout * jnp.arange(L, dtype=jnp.float32) / (L - 1)
+    return jnp.full((L,), cfg.hidden_dropout, dtype=jnp.float32)
+
+
+def embed_tokens(
+    cfg: ModelConfig,
+    params: Dict[str, Any],
+    tokens: jnp.ndarray,                  # [B, S] int32
+    positions: Optional[jnp.ndarray],
+    dropout_key: Optional[jax.Array] = None,
+) -> jnp.ndarray:
+    """Token (+ absolute position) embedding with embedding dropout
+    (ref: language_model.py:133-262 Embedding)."""
+    x = jnp.take(params["embed"]["tokens"], tokens, axis=0)
+    if cfg.position_embedding_type == "absolute":
+        pos = positions if positions is not None else jnp.arange(tokens.shape[1])[None, :]
+        x = x + jnp.take(params["embed"]["pos"], pos, axis=0)
+    if cfg.hidden_dropout > 0 and dropout_key is not None:
+        x = _dropout(x, cfg.hidden_dropout, dropout_key)
+    return x
+
+
+def lm_logits(cfg: ModelConfig, params: Dict[str, Any], x: jnp.ndarray) -> jnp.ndarray:
+    """Project hidden states to vocab logits, tied or untied
+    (ref: parallel_lm_logits, language_model.py:24-53)."""
+    if cfg.tie_embed_logits:
+        w = params["embed"]["tokens"]  # [V, h]
+        return jnp.einsum("bsh,vh->bsv", x, w)
+    return jnp.einsum("bsh,hv->bsv", x, params["lm_head"]["w"])
+
+
+def lm_forward(
+    cfg: ModelConfig,
+    params: Dict[str, Any],
+    tokens: jnp.ndarray,
+    positions: Optional[jnp.ndarray] = None,
+    dropout_key: Optional[jax.Array] = None,
+    recompute: str = "none",
+    sharder: Sharder = _identity_sharder,
+    kv_caches: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,  # [L,B,Smax,nkv,D] x2
+    cache_index=None,
+    return_hidden: bool = False,
+):
+    """Forward pass to logits.
+
+    kv_caches: stacked per-layer caches for incremental decoding; when
+    given, returns (logits, updated_caches).
+    """
+    train = dropout_key is not None and (cfg.hidden_dropout > 0 or cfg.attention_dropout > 0)
+    x = embed_tokens(
+        cfg, params, tokens, positions,
+        dropout_key=jax.random.fold_in(dropout_key, 0xE0B) if train else None,
+    )
+    x = sharder(x, "residual")
+
+    rope = None
+    if cfg.position_embedding_type == "rotary":
+        if kv_caches is not None:
+            rope_len = kv_caches[0].shape[2]  # cache max length
+        else:
+            rope_len = max(cfg.seq_length, tokens.shape[1])
+        rope = precompute_rope(cfg.head_dim, rope_len, cfg.rope_theta,
+                               cfg.rope_scaling_factor)
+
+    rates = _layer_dropout_rates(cfg)
+
+    def body(carry, scanned):
+        x = carry
+        lp, rate, idx, caches = scanned
+        key = jax.random.fold_in(dropout_key, idx) if train else None
+        y, new_cache = block_forward(
+            cfg, lp, x, rope, positions,
+            dropout_key=key,
+            hidden_dropout_rate=rate,
+            kv_cache=caches,
+            cache_index=cache_index,
+            sharder=sharder,
+        )
+        return y, new_cache
+
+    policy = _remat_policy(recompute)
+    if policy is not None:
+        body = jax.checkpoint(body, policy=policy, prevent_cse=False)
+
+    layer_idx = jnp.arange(cfg.num_layers)
+    xs = (params["layers"], rates, layer_idx, kv_caches)
+    x, new_caches = jax.lax.scan(body, x, xs)
+
+    x = norm_forward(cfg.normalization, x, params["final_ln"]["scale"],
+                     params["final_ln"].get("bias"), cfg.layernorm_epsilon)
+    if return_hidden:
+        return x
+
+    logits = lm_logits(cfg, params, x)
+    logits = sharder(logits, "logits")
+    if kv_caches is not None:
+        return logits, new_caches
+    return logits
+
+
+def lm_loss(
+    cfg: ModelConfig,
+    params: Dict[str, Any],
+    batch: Dict[str, jnp.ndarray],
+    dropout_key: Optional[jax.Array] = None,
+    recompute: str = "none",
+    sharder: Sharder = _identity_sharder,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Training loss on a batch dict with keys:
+    tokens [B,S], labels [B,S], loss_mask [B,S], optional position_ids.
+
+    Matches the reference contract: per-token CE weighted by loss_mask
+    (gpt_model.py post_language_model_processing + finetune.py loss_func).
+    """
+    logits = lm_forward(
+        cfg, params, batch["tokens"],
+        positions=batch.get("position_ids"),
+        dropout_key=dropout_key,
+        recompute=recompute,
+        sharder=sharder,
+    )
+    mean, per_token = cross_entropy_loss(
+        logits, batch["labels"], loss_mask=batch.get("loss_mask"))
+    ntokens = (jnp.sum(batch["loss_mask"]) if "loss_mask" in batch
+               else jnp.asarray(per_token.size, jnp.float32))
+    return mean, {"lm_loss": mean, "ntokens": ntokens}

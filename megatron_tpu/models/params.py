@@ -1,0 +1,135 @@
+"""Parameter tree: shapes, initialization, and partition specs.
+
+Single source of truth replacing the reference's scattered parameter
+creation (megatron/core/tensor_parallel/layers.py _initialize_affine_weight*,
+megatron/model/transformer.py module __init__s) and its init policy
+(init_method_normal / scaled_init_method_normal, megatron/model/utils.py).
+
+Layer parameters are stacked with a leading layer axis [L, ...] so the
+forward is a lax.scan (compile-time O(1) in depth) and pipeline stages are
+a reshape of the same arrays — the reference's per-stage layer-offset
+bookkeeping (transformer.py:1045-1075) becomes indexing.
+
+A weight init here is *topology-independent*: the same seed gives the same
+logical weights at any (dp, tp, pp) — stronger than the reference, where
+changing TP changes the per-shard rng draws.
+"""
+
+from __future__ import annotations
+
+import math
+import zlib
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from megatron_tpu.config import ModelConfig
+from megatron_tpu.ops.activations import mlp_input_width_factor
+from megatron_tpu.parallel.mesh import AXIS_PIPE, AXIS_TENSOR
+
+# init kinds
+_NORMAL = "normal"          # N(0, init_method_std)
+_SCALED = "scaled_normal"   # N(0, std / sqrt(2 * num_layers))  (output-facing)
+_ONES = "ones"
+_ZEROS = "zeros"
+
+
+def _defs(cfg: ModelConfig) -> Dict[str, Any]:
+    """Flat {'/'-joined path: (shape, partition_spec, init_kind)}."""
+    h = cfg.hidden_size
+    L = cfg.num_layers
+    D = cfg.head_dim
+    nq, nkv = cfg.num_attention_heads, cfg.n_kv_heads
+    F = cfg.ffn_size
+    Fin = F * mlp_input_width_factor(cfg.activation)
+    V = cfg.vocab_size
+
+    d: Dict[str, Any] = {}
+    d["embed/tokens"] = ((V, h), P(AXIS_TENSOR, None), _NORMAL)
+    if cfg.position_embedding_type == "absolute":
+        d["embed/pos"] = ((cfg.max_position_embeddings, h), P(None, None), _NORMAL)
+
+    ln_bias = cfg.normalization == "layernorm"
+
+    def norm(prefix: str):
+        d[f"{prefix}/scale"] = ((L, h), P(AXIS_PIPE, None), _ONES)
+        if ln_bias:
+            d[f"{prefix}/bias"] = ((L, h), P(AXIS_PIPE, None), _ZEROS)
+
+    norm("layers/ln1")
+    if not cfg.parallel_attn:
+        norm("layers/ln2")
+    if cfg.parallel_layernorm:
+        norm("layers/ln_mlp")
+
+    d["layers/attn/wq"] = ((L, h, nq * D), P(AXIS_PIPE, None, AXIS_TENSOR), _NORMAL)
+    d["layers/attn/wk"] = ((L, h, nkv * D), P(AXIS_PIPE, None, AXIS_TENSOR), _NORMAL)
+    d["layers/attn/wv"] = ((L, h, nkv * D), P(AXIS_PIPE, None, AXIS_TENSOR), _NORMAL)
+    d["layers/attn/wo"] = ((L, nq * D, h), P(AXIS_PIPE, AXIS_TENSOR, None), _SCALED)
+    if cfg.use_bias_qkv:
+        d["layers/attn/bq"] = ((L, nq * D), P(AXIS_PIPE, AXIS_TENSOR), _ZEROS)
+        d["layers/attn/bk"] = ((L, nkv * D), P(AXIS_PIPE, AXIS_TENSOR), _ZEROS)
+        d["layers/attn/bv"] = ((L, nkv * D), P(AXIS_PIPE, AXIS_TENSOR), _ZEROS)
+    if cfg.use_bias_linear:
+        d["layers/attn/bo"] = ((L, h), P(AXIS_PIPE, None), _ZEROS)
+
+    d["layers/mlp/w_in"] = ((L, h, Fin), P(AXIS_PIPE, None, AXIS_TENSOR), _NORMAL)
+    d["layers/mlp/w_out"] = ((L, F, h), P(AXIS_PIPE, AXIS_TENSOR, None), _SCALED)
+    if cfg.use_bias_linear:
+        d["layers/mlp/b_in"] = ((L, Fin), P(AXIS_PIPE, AXIS_TENSOR), _ZEROS)
+        d["layers/mlp/b_out"] = ((L, h), P(AXIS_PIPE, None), _ZEROS)
+
+    d["final_ln/scale"] = ((h,), P(None), _ONES)
+    if ln_bias:
+        d["final_ln/bias"] = ((h,), P(None), _ZEROS)
+    if not cfg.tie_embed_logits:
+        d["lm_head/w"] = ((h, V), P(None, AXIS_TENSOR), _NORMAL)
+    return d
+
+
+def _nest(flat: Dict[str, Any]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for path, v in flat.items():
+        node = out
+        parts = path.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    return out
+
+
+def param_shapes(cfg: ModelConfig) -> Dict[str, Any]:
+    return _nest({k: jax.ShapeDtypeStruct(s, cfg.dtype) for k, (s, _, _) in _defs(cfg).items()})
+
+
+def param_specs(cfg: ModelConfig) -> Dict[str, Any]:
+    return _nest({k: spec for k, (_, spec, _) in _defs(cfg).items()})
+
+
+def num_params(cfg: ModelConfig) -> int:
+    return sum(math.prod(s) for s, _, _ in _defs(cfg).values())
+
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=None) -> Dict[str, Any]:
+    """Initialize the full parameter pytree.
+
+    Each tensor gets its own key folded from a stable hash of its path, so
+    adding/removing optional params never perturbs the others.
+    """
+    dtype = dtype or cfg.dtype
+    defs = _defs(cfg)
+    flat = {}
+    scaled_std = cfg.init_method_std / math.sqrt(2.0 * cfg.num_layers) \
+        if cfg.use_scaled_init else cfg.init_method_std
+    for path, (shape, _, kind) in sorted(defs.items()):
+        if kind == _ONES:
+            flat[path] = jnp.ones(shape, dtype)
+        elif kind == _ZEROS:
+            flat[path] = jnp.zeros(shape, dtype)
+        else:
+            std = scaled_std if kind == _SCALED else cfg.init_method_std
+            k = jax.random.fold_in(key, zlib.crc32(path.encode()) & 0x7FFFFFFF)
+            flat[path] = (jax.random.normal(k, shape, jnp.float32) * std).astype(dtype)
+    return _nest(flat)

@@ -1,0 +1,159 @@
+"""The unified transformer decoder block.
+
+One configurable block is the union of the reference's model zoo
+(megatron/model/transformer.py ParallelTransformerLayer / ParallelAttention /
+ParallelMLP, 1,282 LoC):
+
+  * pre-LN GPT block (layernorm, gelu, biases, absolute pos-emb)
+  * Llama/Mistral block (rmsnorm, swiglu, rotary, no biases, GQA, window)
+  * Falcon block (parallel attention — mlp and attn share the residual add,
+    transformer.py parallel_attn; Falcon-40B's extra mlp layernorm =
+    parallel_layernorm; MQA/GQA)
+
+The reference's Column/RowParallelLinear pairs are plain einsums here; their
+sharding lives in models/params.py partition specs. KV caching for
+incremental decoding follows InferenceParams (ref:
+megatron/text_generation/forward_step.py:17-43) as functional state.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from megatron_tpu.config import ModelConfig
+from megatron_tpu.ops.activations import apply_activation
+from megatron_tpu.ops.attention import attention
+from megatron_tpu.ops.normalization import norm_forward
+from megatron_tpu.ops.rotary import apply_rotary_emb
+
+Sharder = Callable[[jnp.ndarray, str], jnp.ndarray]
+
+
+def _identity_sharder(x: jnp.ndarray, role: str) -> jnp.ndarray:
+    return x
+
+
+def _norm(cfg: ModelConfig, p: Dict[str, Any], x: jnp.ndarray) -> jnp.ndarray:
+    return norm_forward(cfg.normalization, x, p["scale"], p.get("bias"),
+                        cfg.layernorm_epsilon)
+
+
+def _dropout(x: jnp.ndarray, rate, key: Optional[jax.Array]) -> jnp.ndarray:
+    if key is None:
+        return x
+    keep = jax.random.bernoulli(key, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), jnp.zeros_like(x))
+
+
+def attention_block(
+    cfg: ModelConfig,
+    p: Dict[str, Any],  # layers/attn subtree, unstacked
+    x: jnp.ndarray,     # [B, S, h] (already normed)
+    rope: Optional[Tuple[jnp.ndarray, jnp.ndarray]],
+    positions: Optional[jnp.ndarray],
+    attn_dropout_key: Optional[jax.Array] = None,
+    kv_cache: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+    cache_index=None,
+) -> Tuple[jnp.ndarray, Optional[Tuple[jnp.ndarray, jnp.ndarray]]]:
+    """Returns (out [B,S,h], updated kv_cache)."""
+    b, s, _ = x.shape
+    D = cfg.head_dim
+    nq, nkv = cfg.num_attention_heads, cfg.n_kv_heads
+
+    q = jnp.einsum("bsh,hd->bsd", x, p["wq"])
+    k = jnp.einsum("bsh,hd->bsd", x, p["wk"])
+    v = jnp.einsum("bsh,hd->bsd", x, p["wv"])
+    if "bq" in p:
+        q, k, v = q + p["bq"], k + p["bk"], v + p["bv"]
+    q = q.reshape(b, s, nq, D)
+    k = k.reshape(b, s, nkv, D)
+    v = v.reshape(b, s, nkv, D)
+
+    if rope is not None:
+        q, k = apply_rotary_emb(q, k, rope[0], rope[1], positions)
+
+    q_offset = 0
+    if kv_cache is not None:
+        # functional KV cache: fixed-size [B, max_seq, nkv, D] buffers,
+        # in-place slice update at cache_index (donated under jit).
+        kc, vc = kv_cache
+        kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype), (0, cache_index, 0, 0))
+        vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype), (0, cache_index, 0, 0))
+        k, v = kc, vc
+        kv_cache = (kc, vc)
+        q_offset = cache_index
+
+    ctx = attention(
+        q, k, v,
+        mask_type=cfg.attn_mask_type,
+        sliding_window=cfg.sliding_window_size,
+        dropout=cfg.attention_dropout if attn_dropout_key is not None else 0.0,
+        dropout_rng=attn_dropout_key,
+        q_offset=q_offset,
+        impl=cfg.attention_impl,
+        softmax_fp32=cfg.softmax_fp32,
+    )
+    out = jnp.einsum("bsd,dh->bsh", ctx.reshape(b, s, nq * D), p["wo"])
+    if "bo" in p:
+        out = out + p["bo"]
+    return out, kv_cache
+
+
+def mlp_block(cfg: ModelConfig, p: Dict[str, Any], x: jnp.ndarray) -> jnp.ndarray:
+    h = jnp.einsum("bsh,hf->bsf", x, p["w_in"])
+    if "b_in" in p:
+        h = h + p["b_in"]
+    h = apply_activation(cfg.activation, h)
+    out = jnp.einsum("bsf,fh->bsh", h, p["w_out"])
+    if "b_out" in p:
+        out = out + p["b_out"]
+    return out
+
+
+def block_forward(
+    cfg: ModelConfig,
+    lp: Dict[str, Any],  # one layer's params (unstacked)
+    x: jnp.ndarray,      # [B, S, h]
+    rope: Optional[Tuple[jnp.ndarray, jnp.ndarray]],
+    positions: Optional[jnp.ndarray] = None,
+    dropout_key: Optional[jax.Array] = None,
+    hidden_dropout_rate=None,
+    kv_cache: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+    cache_index=None,
+    sharder: Sharder = _identity_sharder,
+) -> Tuple[jnp.ndarray, Optional[Tuple[jnp.ndarray, jnp.ndarray]]]:
+    """One decoder layer. hidden_dropout_rate may be a traced scalar (LIMA
+    per-layer ramp, ref transformer.py:994-1001)."""
+    if dropout_key is not None:
+        k_attn_drop, k_hidden1, k_hidden2 = jax.random.split(dropout_key, 3)
+    else:
+        k_attn_drop = k_hidden1 = k_hidden2 = None
+    rate = cfg.hidden_dropout if hidden_dropout_rate is None else hidden_dropout_rate
+
+    normed = _norm(cfg, lp["ln1"], x)
+    attn_out, kv_cache = attention_block(
+        cfg, lp["attn"], normed, rope, positions,
+        attn_dropout_key=k_attn_drop if cfg.attention_dropout > 0 else None,
+        kv_cache=kv_cache, cache_index=cache_index,
+    )
+    attn_out = _dropout(attn_out, rate, k_hidden1 if cfg.hidden_dropout > 0 else None)
+
+    if cfg.parallel_attn:
+        # Falcon: mlp input is ln1(x) (7B) or a dedicated ln_mlp(x) (40B);
+        # one residual add for both branches.
+        mlp_in = _norm(cfg, lp["ln_mlp"], x) if cfg.parallel_layernorm else normed
+        mlp_out = mlp_block(cfg, lp["mlp"], mlp_in)
+        mlp_out = _dropout(mlp_out, rate, k_hidden2 if cfg.hidden_dropout > 0 else None)
+        y = x + attn_out + mlp_out
+    else:
+        y = x + attn_out
+        y = sharder(y, "residual")
+        normed2 = _norm(cfg, lp["ln2"], y)
+        mlp_out = mlp_block(cfg, lp["mlp"], normed2)
+        mlp_out = _dropout(mlp_out, rate, k_hidden2 if cfg.hidden_dropout > 0 else None)
+        y = y + mlp_out
+    y = sharder(y, "residual")
+    return y, kv_cache

@@ -1,0 +1,17 @@
+from megatron_tpu.ops.normalization import layernorm, rmsnorm, norm_forward
+from megatron_tpu.ops.activations import apply_activation, mlp_input_width_factor
+from megatron_tpu.ops.rotary import precompute_rope, apply_rotary_emb
+from megatron_tpu.ops.attention import attention
+from megatron_tpu.ops.cross_entropy import cross_entropy_loss
+
+__all__ = [
+    "layernorm",
+    "rmsnorm",
+    "norm_forward",
+    "apply_activation",
+    "mlp_input_width_factor",
+    "precompute_rope",
+    "apply_rotary_emb",
+    "attention",
+    "cross_entropy_loss",
+]

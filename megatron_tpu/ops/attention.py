@@ -1,0 +1,116 @@
+"""Attention.
+
+Covers the reference's attention stack — CoreAttention (baddbmm +
+FusedScaleMaskSoftmax, megatron/model/transformer.py CoreAttention;
+megatron/model/fused_softmax.py + the three CUDA softmax kernels in
+megatron/fused_kernels/) and the FlashAttention-2 fast path
+(transformer.py:524-553) including Mistral's sliding window
+(transformer.py:528-536) and GQA/MQA kv-head broadcast
+(transformer.py:450-465).
+
+Two implementations behind one dispatch:
+  * "xla": einsum attention with fp32 softmax. XLA fuses
+    scale+mask+softmax into the matmuls, which is what the reference's
+    three fused CUDA softmax kernels exist to do by hand.
+  * "pallas": blockwise flash-attention kernel (megatron_tpu/ops/pallas/
+    flash_attention.py) — O(seq) memory, causal + sliding window + GQA.
+
+Layout is [batch, seq, heads, head_dim] throughout (no [s, b, h] flips —
+the reference's seq-first layout is a CUDA-kernel legacy).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _mask_bias(
+    q_len: int,
+    kv_len: int,
+    mask_type: str,
+    sliding_window: Optional[int],
+    q_offset,
+    dtype,
+) -> Optional[jnp.ndarray]:
+    """Additive bias [q_len, kv_len]; None when fully visible."""
+    if mask_type == "bidirectional" and sliding_window is None:
+        return None
+    q_pos = q_offset + jnp.arange(q_len)[:, None]
+    k_pos = jnp.arange(kv_len)[None, :]
+    allowed = jnp.ones((q_len, kv_len), dtype=bool)
+    if mask_type == "causal":
+        allowed &= k_pos <= q_pos
+    if sliding_window is not None:
+        # Mistral window: attend to at most the last W positions
+        allowed &= k_pos > q_pos - sliding_window
+    neg = jnp.asarray(jnp.finfo(dtype).min, dtype=dtype)
+    return jnp.where(allowed, jnp.zeros((), dtype), neg)
+
+
+def attention(
+    q: jnp.ndarray,  # [B, Sq, Hq, D]
+    k: jnp.ndarray,  # [B, Skv, Hkv, D]
+    v: jnp.ndarray,  # [B, Skv, Hkv, D]
+    mask_type: str = "causal",
+    sliding_window: Optional[int] = None,
+    padding_mask: Optional[jnp.ndarray] = None,  # [B, Skv] True = keep
+    dropout: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
+    q_offset=0,
+    impl: str = "xla",
+    softmax_fp32: bool = True,
+) -> jnp.ndarray:
+    """Scaled dot-product attention with GQA. Returns [B, Sq, Hq, D].
+
+    q_offset: absolute position of q[0] (incremental decoding with KV cache).
+    """
+    if impl == "pallas":
+        can_use = (
+            dropout == 0.0
+            and padding_mask is None
+            and q.shape[1] == k.shape[1]
+            and mask_type == "causal"
+        )
+        if can_use:
+            try:
+                from megatron_tpu.ops.pallas.flash_attention import flash_attention
+            except ImportError:
+                flash_attention = None
+            if flash_attention is not None:
+                return flash_attention(q, k, v, sliding_window=sliding_window)
+        # fall through to the XLA path for shapes/features the kernel
+        # doesn't cover (decode steps, padding masks, dropout)
+
+    b, sq, hq, d = q.shape
+    _, skv, hkv, _ = k.shape
+    groups = hq // hkv
+
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, dtype=jnp.float32))
+    qf = (q.astype(jnp.float32) * scale) if softmax_fp32 else q * scale.astype(q.dtype)
+    kf = k.astype(jnp.float32) if softmax_fp32 else k
+    vf = v
+
+    # group query heads over kv heads: [B, S, Hkv, G, D]
+    qg = qf.reshape(b, sq, hkv, groups, d)
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, kf)  # [B, Hkv, G, Sq, Skv]
+
+    bias = _mask_bias(sq, skv, mask_type, sliding_window, q_offset, scores.dtype)
+    if bias is not None:
+        scores = scores + bias
+    if padding_mask is not None:
+        neg = jnp.asarray(jnp.finfo(scores.dtype).min, scores.dtype)
+        scores = jnp.where(padding_mask[:, None, None, None, :], scores, neg)
+
+    probs = jax.nn.softmax(scores, axis=-1)
+    if dropout > 0.0:
+        if dropout_rng is None:
+            raise ValueError("attention dropout requires a PRNG key")
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout), 0.0)
+
+    probs = probs.astype(vf.dtype)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, vf)
+    return out.reshape(b, sq, hq, d)

@@ -1,0 +1,59 @@
+"""LayerNorm / RMSNorm.
+
+TPU-native equivalent of the reference's fused CUDA mixed-precision
+LayerNorm (megatron/fused_kernels/layer_norm_cuda*, 1,005 LoC; wrapper
+megatron/model/fused_layer_norm.py) and its pure-torch RMSNorm
+(fused_layer_norm.py:125-139). On TPU the fusion is XLA's job: these are
+plain jnp expressions computed in fp32 and cast back, and XLA fuses the
+whole thing into neighbouring ops. A Pallas single-pass kernel exists in
+megatron_tpu/ops/pallas/ for the cases profiling shows XLA leaves on the
+table.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def rmsnorm(x: jnp.ndarray, scale: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    """x * rsqrt(mean(x^2) + eps) * scale, computed in fp32
+    (ref: fused_layer_norm.py:125-139 also upcasts to fp32)."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    y = xf * jax.lax.rsqrt(var + eps)
+    return (y * scale.astype(jnp.float32)).astype(dtype)
+
+
+def layernorm(
+    x: jnp.ndarray,
+    scale: jnp.ndarray,
+    bias: Optional[jnp.ndarray],
+    eps: float = 1e-5,
+) -> jnp.ndarray:
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    y = y * scale.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(dtype)
+
+
+def norm_forward(
+    kind: str,
+    x: jnp.ndarray,
+    scale: jnp.ndarray,
+    bias: Optional[jnp.ndarray] = None,
+    eps: float = 1e-5,
+) -> jnp.ndarray:
+    if kind == "rmsnorm":
+        return rmsnorm(x, scale, eps)
+    if kind == "layernorm":
+        return layernorm(x, scale, bias, eps)
+    raise ValueError(f"unknown normalization {kind!r}")

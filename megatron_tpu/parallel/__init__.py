@@ -1,0 +1,20 @@
+from megatron_tpu.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_PIPE,
+    AXIS_CONTEXT,
+    AXIS_TENSOR,
+    MeshRuntime,
+    build_mesh,
+)
+from megatron_tpu.parallel.random import RngStreams, model_init_key
+
+__all__ = [
+    "AXIS_DATA",
+    "AXIS_PIPE",
+    "AXIS_CONTEXT",
+    "AXIS_TENSOR",
+    "MeshRuntime",
+    "build_mesh",
+    "RngStreams",
+    "model_init_key",
+]

@@ -1,0 +1,95 @@
+"""Device-mesh topology.
+
+TPU-native replacement for the reference's process-group machinery
+(megatron/core/parallel_state.py:51-494: initialize_model_parallel and its
+40+ group getters). There, DP/TP/PP ranks are carved out of a flat NCCL world
+with TP innermost-contiguous; here the same layout is one
+``jax.sharding.Mesh`` whose last axis is "tensor", so TP collectives ride the
+innermost ICI links. All of the getters (get_tensor_model_parallel_rank() &
+co.) collapse into ``jax.lax.axis_index(axis)`` inside shard_map, or simply
+into sharding specs under GSPMD.
+
+The reference's "embedding group" (first+last pipeline stages syncing tied
+embedding grads, parallel_state.py:174-184) has no group object here: tied
+weights live in a shared param subtree and XLA reduces their cotangents.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from megatron_tpu.config import ParallelConfig
+
+AXIS_DATA = "data"
+AXIS_PIPE = "pipe"
+AXIS_CONTEXT = "context"
+AXIS_TENSOR = "tensor"
+MESH_AXES = (AXIS_DATA, AXIS_PIPE, AXIS_CONTEXT, AXIS_TENSOR)
+
+# Sequence ("batch") sharding of activations: batch over data, sequence over
+# context. With sequence_parallel the seq dim is additionally split over
+# tensor in the residual stream (see megatron_tpu/parallel/sharding.py).
+BATCH_SPEC = P(AXIS_DATA, AXIS_CONTEXT)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshRuntime:
+    """A mesh plus the resolved parallel config (dp filled in)."""
+
+    mesh: Mesh
+    parallel: ParallelConfig
+    data_parallel: int
+
+    @property
+    def tp(self) -> int:
+        return self.parallel.tensor_parallel
+
+    @property
+    def pp(self) -> int:
+        return self.parallel.pipeline_parallel
+
+    @property
+    def cp(self) -> int:
+        return self.parallel.context_parallel
+
+    @property
+    def dp(self) -> int:
+        return self.data_parallel
+
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+
+def build_mesh(
+    parallel: ParallelConfig,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> MeshRuntime:
+    """Build the ("data", "pipe", "context", "tensor") mesh.
+
+    Axis order puts tensor last (fastest-varying device index) so that TP —
+    the highest-bandwidth-demand axis — maps onto physically adjacent chips,
+    mirroring the reference's TP-innermost rank layout
+    (parallel_state.py:68-82). DP is outermost and is the natural axis to
+    span DCN between slices.
+    """
+    parallel = parallel.validate()
+    devices = list(devices if devices is not None else jax.devices())
+    dp = parallel.derive_data_parallel(len(devices))
+    shape = (dp, parallel.pipeline_parallel, parallel.context_parallel,
+             parallel.tensor_parallel)
+    dev_array = np.asarray(devices).reshape(shape)
+    mesh = Mesh(dev_array, MESH_AXES)
+    return MeshRuntime(mesh=mesh, parallel=parallel, data_parallel=dp)
+
+
+def single_device_mesh() -> MeshRuntime:
+    """1x1x1x1 mesh on the first device — degenerate-topology runs."""
+    return build_mesh(ParallelConfig(), devices=jax.devices()[:1])

@@ -1,0 +1,64 @@
+"""PRNG policy.
+
+The reference maintains a mutable CUDA rng tracker with distinct
+"model-parallel" seeds per TP rank and a per-pipeline-stage seed offset
+(megatron/core/tensor_parallel/random.py:139; megatron/initialize.py:179-193:
+seed + 100 * pp_rank, optional per-DP offset). The *policy* it implements is:
+
+  * weight init: identical across DP, distinct where the tensor is sharded
+    (JAX gives this for free — one key, sharded init is deterministic per
+    logical tensor, independent of topology; an improvement over the
+    reference where changing TP changes init),
+  * dropout: distinct streams per TP shard / pipeline stage, identical
+    across DP replicas.
+
+Here keys are values, not global state: ``RngStreams`` derives named
+per-purpose streams from one base seed with ``jax.random.fold_in``, and
+per-step keys by folding in the iteration counter — fully deterministic
+resume without checkpointing rng state blobs (the reference must save all
+five generator states, checkpointing.py:217-240; we only save the seed and
+step).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+# Stable stream ids (never reorder — checkpoint determinism).
+_STREAMS = {
+    "params": 0,
+    "dropout": 1,
+    "data": 2,
+    "sampling": 3,
+}
+
+
+def model_init_key(seed: int) -> jax.Array:
+    return jax.random.fold_in(jax.random.PRNGKey(seed), _STREAMS["params"])
+
+
+@dataclasses.dataclass(frozen=True)
+class RngStreams:
+    """Named, step-indexed PRNG streams derived from one seed."""
+
+    seed: int
+
+    def base(self, stream: str) -> jax.Array:
+        return jax.random.fold_in(jax.random.PRNGKey(self.seed), _STREAMS[stream])
+
+    def params(self) -> jax.Array:
+        return self.base("params")
+
+    def step(self, stream: str, iteration) -> jax.Array:
+        """Key for `stream` at a given training iteration (traceable)."""
+        return jax.random.fold_in(self.base(stream), iteration)
+
+    def dropout(self, iteration) -> jax.Array:
+        return self.step("dropout", iteration)
+
+    def data(self, epoch: int) -> jax.Array:
+        return self.step("data", epoch)

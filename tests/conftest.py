@@ -1,0 +1,24 @@
+"""Test configuration: 8 fake CPU devices for distributed tests.
+
+The reference needs >=2 real GPUs and torchrun for its distributed tests
+(tests/test_utilities.py in /root/reference); here every topology test runs
+on a virtual CPU mesh.
+
+Note: the host environment may pre-import jax and pin JAX_PLATFORMS to a
+TPU plugin via sitecustomize, so plain env vars are too late — we force the
+platform through jax.config before any backend is initialized.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,60 @@
+"""Topology tests (counterpart of reference tests/test_parallel_state.py)."""
+
+import jax
+import numpy as np
+import pytest
+
+from megatron_tpu.config import ParallelConfig
+from megatron_tpu.parallel.mesh import MESH_AXES, build_mesh
+from megatron_tpu.parallel.sharding import zero1_spec
+from jax.sharding import PartitionSpec as P
+
+
+def test_eight_fake_devices():
+    assert len(jax.devices()) == 8
+
+
+@pytest.mark.parametrize("tp,pp,cp,dp", [
+    (2, 2, 1, 2), (4, 1, 1, 2), (1, 4, 1, 2), (2, 1, 2, 2), (8, 1, 1, 1), (1, 1, 1, 8),
+])
+def test_mesh_shapes(tp, pp, cp, dp):
+    rt = build_mesh(ParallelConfig(tensor_parallel=tp, pipeline_parallel=pp,
+                                   context_parallel=cp))
+    assert rt.mesh.axis_names == MESH_AXES
+    assert rt.mesh.shape["tensor"] == tp
+    assert rt.mesh.shape["pipe"] == pp
+    assert rt.mesh.shape["context"] == cp
+    assert rt.dp == dp
+
+
+def test_tensor_axis_innermost():
+    """TP must map to adjacent device ids (the reference's
+    TP-innermost-contiguous layout, parallel_state.py:68-82)."""
+    rt = build_mesh(ParallelConfig(tensor_parallel=4))
+    ids = np.vectorize(lambda d: d.id)(rt.mesh.devices)
+    # within one tp group, device ids are consecutive
+    first_group = ids[0, 0, 0, :]
+    assert list(first_group) == list(range(first_group[0], first_group[0] + 4))
+
+
+def test_invalid_topology():
+    with pytest.raises(ValueError):
+        build_mesh(ParallelConfig(tensor_parallel=3))
+
+
+def test_data_parallel_mismatch():
+    with pytest.raises(ValueError):
+        build_mesh(ParallelConfig(tensor_parallel=2, data_parallel=8))
+
+
+def test_zero1_spec():
+    # first unsharded divisible dim picks up the data axis
+    s = zero1_spec(P(None, "tensor"), (64, 128), dp=4)
+    assert s == P("data", "tensor")
+    s = zero1_spec(P("pipe", None, "tensor"), (2, 64, 128), dp=4)
+    assert s == P("pipe", "data", "tensor")
+    # indivisible dims stay replicated
+    s = zero1_spec(P(None), (63,), dp=4)
+    assert s == P(None)
+    # dp=1 is a no-op
+    assert zero1_spec(P(None, "tensor"), (64, 128), dp=1) == P(None, "tensor")

@@ -1,0 +1,144 @@
+"""Model forward tests: shapes, determinism, preset coverage, KV-cache
+equivalence (counterpart of reference tests/test_layernorm_order.py's
+single-layer end-to-end check)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatron_tpu.models import presets
+from megatron_tpu.models.language_model import lm_forward, lm_loss
+from megatron_tpu.models.params import init_params, num_params, param_specs, param_shapes
+
+
+def _batch(cfg, batch=2, seq=16, seed=0):
+    rng = np.random.default_rng(seed)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32)
+    labels = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32)
+    return {"tokens": tokens, "labels": labels,
+            "loss_mask": jnp.ones((batch, seq), jnp.float32)}
+
+
+@pytest.mark.parametrize("kw", [
+    dict(),                                                      # llama-ish
+    dict(normalization="layernorm", activation="gelu",
+         use_bias_linear=True, use_bias_qkv=True,
+         tie_embed_logits=True, position_embedding_type="absolute"),  # gpt-ish
+    dict(normalization="layernorm", activation="gelu",
+         parallel_attn=True, tie_embed_logits=True, num_kv_heads=1),  # falcon-ish
+    dict(normalization="layernorm", activation="gelu", parallel_attn=True,
+         parallel_layernorm=True, tie_embed_logits=True),        # falcon-40b-ish
+    dict(sliding_window_size=8),                                 # mistral-ish
+])
+def test_forward_shapes_all_variants(kw):
+    if kw.get("position_embedding_type") == "absolute":
+        kw["max_position_embeddings"] = 128
+    cfg = presets.tiny(**kw)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    batch = _batch(cfg)
+    logits = lm_forward(cfg, params, batch["tokens"])
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert jnp.isfinite(logits).all()
+
+
+def test_param_tree_matches_specs_and_shapes():
+    cfg = presets.tiny()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    specs = param_specs(cfg)
+    shapes = param_shapes(cfg)
+    assert jax.tree.structure(params) == jax.tree.structure(shapes)
+    flat_p = jax.tree.leaves(params)
+    flat_s = jax.tree.leaves(shapes)
+    for p, s in zip(flat_p, flat_s):
+        assert p.shape == s.shape
+    # spec tree mirrors param tree (specs are leaves)
+    from jax.sharding import PartitionSpec as P
+    spec_struct = jax.tree.structure(specs, is_leaf=lambda x: isinstance(x, P))
+    assert spec_struct == jax.tree.structure(params)
+
+
+def test_deterministic_forward_and_init():
+    cfg = presets.tiny()
+    p1 = init_params(cfg, jax.random.PRNGKey(7))
+    p2 = init_params(cfg, jax.random.PRNGKey(7))
+    assert all((a == b).all() for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)))
+    batch = _batch(cfg)
+    l1 = lm_forward(cfg, p1, batch["tokens"])
+    l2 = lm_forward(cfg, p2, batch["tokens"])
+    np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+
+
+def test_loss_runs_and_is_finite():
+    cfg = presets.tiny()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    loss, aux = lm_loss(cfg, params, _batch(cfg))
+    assert np.isfinite(float(loss))
+    # random init: loss should be near ln(vocab)
+    assert abs(float(loss) - np.log(cfg.vocab_size)) < 1.0
+
+
+def test_recompute_policies_agree():
+    cfg = presets.tiny()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    batch = _batch(cfg)
+
+    def loss_fn(recompute):
+        def f(p):
+            return lm_loss(cfg, p, batch, recompute=recompute)[0]
+        return f
+
+    g_none = jax.grad(loss_fn("none"))(params)
+    g_full = jax.grad(loss_fn("full"))(params)
+    g_sel = jax.grad(loss_fn("selective"))(params)
+    for a, b in zip(jax.tree.leaves(g_none), jax.tree.leaves(g_full)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
+    for a, b in zip(jax.tree.leaves(g_none), jax.tree.leaves(g_sel)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
+
+
+def test_kv_cache_matches_full_forward():
+    """Incremental decode with per-layer caches == full forward
+    (ref: InferenceParams path, text_generation/forward_step.py)."""
+    cfg = presets.tiny()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = _batch(cfg, batch=1, seq=8)["tokens"]
+    full = lm_forward(cfg, params, tokens)
+
+    L, B, S = cfg.num_layers, 1, 8
+    caches = (
+        jnp.zeros((L, B, S, cfg.n_kv_heads, cfg.head_dim), jnp.float32),
+        jnp.zeros((L, B, S, cfg.n_kv_heads, cfg.head_dim), jnp.float32),
+    )
+    # prefill 4 tokens, then decode one at a time
+    pos = jnp.arange(8)[None, :]
+    logits, caches = lm_forward(cfg, params, tokens[:, :4], positions=pos[:, :4],
+                                kv_caches=caches, cache_index=0)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(full[:, :4]),
+                               rtol=2e-3, atol=2e-3)
+    for t in range(4, 8):
+        logits, caches = lm_forward(cfg, params, tokens[:, t:t + 1],
+                                    positions=pos[:, t:t + 1],
+                                    kv_caches=caches, cache_index=t)
+        np.testing.assert_allclose(np.asarray(logits[:, 0]), np.asarray(full[:, t]),
+                                   rtol=2e-3, atol=2e-3)
+
+
+def test_lima_dropout_ramp():
+    from megatron_tpu.models.language_model import _layer_dropout_rates
+    cfg = presets.tiny(hidden_dropout=0.3, lima_dropout=True, num_layers=4)
+    rates = np.asarray(_layer_dropout_rates(cfg))
+    np.testing.assert_allclose(rates, [0.0, 0.1, 0.2, 0.3], atol=1e-6)
+
+
+def test_preset_param_counts():
+    """Sanity: llama-2-7B parameter count ~6.7e9."""
+    cfg = presets.llama("7B", version=2)
+    n = num_params(cfg)
+    assert 6.5e9 < n < 7.0e9
+    cfg = presets.falcon("7B")
+    n = num_params(cfg)
+    assert 6.5e9 < n < 7.5e9
+    cfg = presets.mistral("7B")
+    n = num_params(cfg)
+    assert 7.0e9 < n < 7.5e9

@@ -1,0 +1,157 @@
+"""Numerics tests for core ops vs numpy closed forms
+(counterpart of reference tests/test_activations.py and
+megatron/mpu/tests/test_cross_entropy.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatron_tpu.ops.activations import apply_activation
+from megatron_tpu.ops.attention import attention
+from megatron_tpu.ops.cross_entropy import cross_entropy_loss
+from megatron_tpu.ops.normalization import layernorm, rmsnorm
+from megatron_tpu.ops.rotary import apply_rotary_emb, precompute_rope
+
+RNG = np.random.default_rng(0)
+
+
+def test_rmsnorm():
+    x = RNG.standard_normal((2, 5, 16)).astype(np.float32)
+    w = RNG.standard_normal(16).astype(np.float32)
+    got = rmsnorm(jnp.asarray(x), jnp.asarray(w), eps=1e-5)
+    want = x / np.sqrt((x**2).mean(-1, keepdims=True) + 1e-5) * w
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_layernorm():
+    x = RNG.standard_normal((2, 5, 16)).astype(np.float32)
+    w = RNG.standard_normal(16).astype(np.float32)
+    b = RNG.standard_normal(16).astype(np.float32)
+    got = layernorm(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b), eps=1e-5)
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    want = (x - mu) / np.sqrt(var + 1e-5) * w + b
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("name", ["swiglu", "geglu", "reglu", "liglu"])
+def test_glu_closed_form(name):
+    """GLU = act(gate) * up on a halved last dim
+    (ref tests/test_activations.py checks the same closed forms)."""
+    x = RNG.standard_normal((3, 8)).astype(np.float32)
+    gate, up = x[:, :4], x[:, 4:]
+    got = np.asarray(apply_activation(name, jnp.asarray(x)))
+    if name == "geglu":
+        import math
+        erf = np.vectorize(math.erf)
+        want = gate * 0.5 * (1 + erf(gate / np.sqrt(2))) * up
+    else:
+        acts = {
+            "swiglu": lambda g: g * (1 / (1 + np.exp(-g))),
+            "reglu": lambda g: np.maximum(g, 0),
+            "liglu": lambda g: g,
+        }
+        want = acts[name](gate) * up
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_rope_rotation_preserves_norm():
+    cos, sin = precompute_rope(8, 32)
+    q = jnp.asarray(RNG.standard_normal((1, 16, 2, 8)).astype(np.float32))
+    k = jnp.asarray(RNG.standard_normal((1, 16, 2, 8)).astype(np.float32))
+    qr, kr = apply_rotary_emb(q, k, cos, sin)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(qr), axis=-1),
+        np.linalg.norm(np.asarray(q), axis=-1), rtol=1e-5)
+    # position 0 is the identity rotation
+    np.testing.assert_allclose(np.asarray(qr)[:, 0], np.asarray(q)[:, 0], rtol=1e-6)
+
+
+def test_rope_relative_property():
+    """Scores depend only on relative distance: rotating q,k by equal offset
+    leaves q . k unchanged."""
+    cos, sin = precompute_rope(8, 64)
+    q = jnp.asarray(RNG.standard_normal((1, 1, 1, 8)).astype(np.float32))
+    k = jnp.asarray(RNG.standard_normal((1, 1, 1, 8)).astype(np.float32))
+    pos_a = jnp.asarray([[5]])
+    pos_b = jnp.asarray([[2]])
+    qa, ka = apply_rotary_emb(q, k, cos, sin, pos_a), apply_rotary_emb(q, k, cos, sin, pos_b)
+    # dot(q@p, k@p+d) invariant to p
+    q5, _ = apply_rotary_emb(q, k, cos, sin, jnp.asarray([[5]]))
+    _, k8 = apply_rotary_emb(q, k, cos, sin, jnp.asarray([[8]]))
+    q15, _ = apply_rotary_emb(q, k, cos, sin, jnp.asarray([[15]]))
+    _, k18 = apply_rotary_emb(q, k, cos, sin, jnp.asarray([[18]]))
+    d1 = float(jnp.sum(q5 * k8))
+    d2 = float(jnp.sum(q15 * k18))
+    assert abs(d1 - d2) < 1e-4
+
+
+def test_rope_scaling_interpolates():
+    cos1, _ = precompute_rope(8, 64, scaling_factor=1.0)
+    cos2, _ = precompute_rope(8, 64, scaling_factor=2.0)
+    # position 2p at scale 2 == position p at scale 1
+    np.testing.assert_allclose(np.asarray(cos2)[10], np.asarray(cos1)[5], atol=1e-6)
+
+
+def _ref_attention(q, k, v, causal=True, window=None):
+    b, sq, hq, d = q.shape
+    _, skv, hkv, _ = k.shape
+    g = hq // hkv
+    k = np.repeat(k, g, axis=2)
+    v = np.repeat(v, g, axis=2)
+    scores = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+    qpos = np.arange(sq)[:, None]
+    kpos = np.arange(skv)[None, :]
+    mask = np.ones((sq, skv), bool)
+    if causal:
+        mask &= kpos <= qpos
+    if window is not None:
+        mask &= kpos > qpos - window
+    scores = np.where(mask, scores, -1e30)
+    p = np.exp(scores - scores.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def test_attention_gqa_causal():
+    q = RNG.standard_normal((2, 8, 4, 16)).astype(np.float32)
+    k = RNG.standard_normal((2, 8, 2, 16)).astype(np.float32)
+    v = RNG.standard_normal((2, 8, 2, 16)).astype(np.float32)
+    got = attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    want = _ref_attention(q, k, v)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_attention_sliding_window():
+    q = RNG.standard_normal((1, 12, 2, 8)).astype(np.float32)
+    k = RNG.standard_normal((1, 12, 2, 8)).astype(np.float32)
+    v = RNG.standard_normal((1, 12, 2, 8)).astype(np.float32)
+    got = attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), sliding_window=4)
+    want = _ref_attention(q, k, v, window=4)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_cross_entropy_matches_numpy():
+    logits = RNG.standard_normal((2, 6, 32)).astype(np.float32)
+    targets = RNG.integers(0, 32, (2, 6))
+    mean, per_tok = cross_entropy_loss(jnp.asarray(logits), jnp.asarray(targets))
+    lse = np.log(np.exp(logits).sum(-1))
+    want = lse - np.take_along_axis(logits, targets[..., None], -1)[..., 0]
+    np.testing.assert_allclose(per_tok, want, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(mean, want.mean(), rtol=1e-5)
+
+
+def test_cross_entropy_label_smoothing_and_mask():
+    logits = RNG.standard_normal((1, 4, 16)).astype(np.float32)
+    targets = RNG.integers(0, 16, (1, 4))
+    mask = np.array([[1, 1, 0, 1]], np.float32)
+    eps = 0.1
+    mean, per_tok = cross_entropy_loss(
+        jnp.asarray(logits), jnp.asarray(targets),
+        loss_mask=jnp.asarray(mask), label_smoothing=eps)
+    lse = np.log(np.exp(logits).sum(-1))
+    tl = np.take_along_axis(logits, targets[..., None], -1)[..., 0]
+    want = lse - (1 - eps) * tl - eps * logits.mean(-1)
+    np.testing.assert_allclose(per_tok, want, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(mean, (want * mask).sum() / mask.sum(), rtol=1e-5)
