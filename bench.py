@@ -1,0 +1,111 @@
+"""Benchmark: llama-architecture training-step MFU on one TPU chip.
+
+Prints one JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Method: jitted full training step (fwd + bwd + Adam with fp32 masters,
+selective recompute, bf16 compute) on a llama-family model sized to fit one
+chip's HBM alongside optimizer state. MFU = achieved model FLOP/s over the
+chip's peak bf16 FLOP/s, with model FLOPs = 3x forward (fwd + 2x bwd), the
+convention the reference's FLOP formula supports
+(ref: megatron/model/language_model.py:370-384).
+
+Baseline (BASELINE.md): the reference's Llama-2-7B finetune does ~0.9k
+tokens/s per A100-80GB => MFU = 900 * 6 * 6.74e9 / 312e12 = 0.1166.
+vs_baseline is our MFU / that.
+"""
+
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from megatron_tpu.config import OptimizerConfig, TrainingConfig
+    from megatron_tpu.models import presets
+    from megatron_tpu.models.params import init_params, num_params
+    from megatron_tpu.training.optimizer import init_train_state
+    from megatron_tpu.training.train_step import make_train_step
+
+    # llama-family geometry, ~640M params: fits HBM with fp32 master+moments
+    cfg = presets.tiny(
+        vocab_size=32000, seq_length=2048, hidden_size=2048, num_layers=10,
+        num_attention_heads=16, num_kv_heads=16, ffn_hidden_size=5504,
+        params_dtype="bfloat16",
+    )
+    n_params = num_params(cfg)
+
+    opt_cfg = OptimizerConfig(lr=1e-4, lr_decay_style="constant")
+    micro_bs = 4
+    tcfg = TrainingConfig(micro_batch_size=micro_bs, global_batch_size=micro_bs,
+                          recompute_granularity="selective", seed=0)
+
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    state = init_train_state(opt_cfg, params)
+    step = jax.jit(
+        make_train_step(cfg, opt_cfg, tcfg, num_microbatches=1, train_iters=1000),
+        donate_argnums=(0,),
+    )
+
+    rng = np.random.default_rng(0)
+    batch = {
+        "tokens": jnp.asarray(rng.integers(0, cfg.vocab_size, (micro_bs, cfg.seq_length)), jnp.int32),
+        "labels": jnp.asarray(rng.integers(0, cfg.vocab_size, (micro_bs, cfg.seq_length)), jnp.int32),
+        "loss_mask": jnp.ones((micro_bs, cfg.seq_length), jnp.float32),
+    }
+
+    # warmup / compile. NB: sync via host transfer (float()) — on the axon
+    # TPU plugin block_until_ready returns without waiting.
+    state, metrics = step(state, batch)
+    float(metrics["loss"])
+    state, metrics = step(state, batch)
+    float(metrics["loss"])
+
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step(state, batch)
+    loss_val = float(metrics["loss"])
+    dt = (time.perf_counter() - t0) / iters
+
+    tokens_per_sec = micro_bs * cfg.seq_length / dt
+    flops_per_token = 3.0 * cfg.flops_per_token_fwd()  # fwd + bwd(2x)
+    achieved = tokens_per_sec * flops_per_token
+
+    # peak bf16 FLOP/s by TPU generation
+    peaks = {"v4": 275e12, "v5 lite": 197e12, "v5e": 197e12, "v5litepod": 197e12,
+             "v5p": 459e12, "v5 p": 459e12, "v6e": 918e12, "v6 lite": 918e12}
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", str(dev)).lower()
+    peak = next((v for k, v in peaks.items() if k in kind), None)
+    if peak is None:
+        peak = 197e12  # unknown generation: scored against v5e, flagged below
+    mfu = achieved / peak
+
+    baseline_mfu = 900 * 6 * 6.74e9 / 312e12  # reference A100 finetune
+    print(json.dumps({
+        "metric": "llama_train_step_mfu",
+        "value": round(mfu, 4),
+        "unit": "fraction_of_peak_bf16",
+        "vs_baseline": round(mfu / baseline_mfu, 3),
+        "detail": {
+            "tokens_per_sec_per_chip": round(tokens_per_sec),
+            "step_ms": round(dt * 1e3, 2),
+            "n_params": n_params,
+            "loss": loss_val,
+            "device": str(dev),
+            "device_kind": kind,
+            "peak_flops_assumed": peak,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -28,7 +28,10 @@ NORMALIZATION_TYPES = ("layernorm", "rmsnorm")
 # GLU family per ref megatron/model/glu_activations.py plus plain variants.
 ACTIVATION_TYPES = ("gelu", "geglu", "swiglu", "reglu", "liglu", "relu", "squared_relu")
 GLU_ACTIVATIONS = ("geglu", "swiglu", "reglu", "liglu")
-ATTN_MASK_TYPES = ("causal", "padding", "bidirectional")
+# "padding" joins this list when encoder models (BERT/T5) land; until the
+# padding-mask plumbing exists end-to-end it is rejected rather than
+# silently training with future-token leakage.
+ATTN_MASK_TYPES = ("causal", "bidirectional")
 RECOMPUTE_POLICIES = ("none", "selective", "full")
 DTYPES = {"bfloat16": jnp.bfloat16, "float16": jnp.float16, "float32": jnp.float32}
 

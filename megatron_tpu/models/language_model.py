@@ -96,6 +96,11 @@ def lm_forward(
     kv_caches: stacked per-layer caches for incremental decoding; when
     given, returns (logits, updated_caches).
     """
+    if positions is None and kv_caches is not None:
+        # incremental decode: q tokens sit at absolute positions
+        # cache_index .. cache_index+s-1 (for RoPE and absolute pos-emb)
+        positions = cache_index + jnp.arange(tokens.shape[1])[None, :]
+
     train = dropout_key is not None and (cfg.hidden_dropout > 0 or cfg.attention_dropout > 0)
     x = embed_tokens(
         cfg, params, tokens, positions,

@@ -47,4 +47,6 @@ def apply_activation(name: str, x: jnp.ndarray) -> jnp.ndarray:
 def mlp_input_width_factor(name: str) -> int:
     """GLU activations need a 2x-wide in-projection
     (ref: transformer.py:92-102 doubles the ColumnParallelLinear width)."""
-    return 2 if name in ("swiglu", "geglu", "reglu", "liglu") else 1
+    from megatron_tpu.config import GLU_ACTIVATIONS
+
+    return 2 if name in GLU_ACTIVATIONS else 1

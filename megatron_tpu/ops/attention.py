@@ -21,6 +21,7 @@ the reference's seq-first layout is a CUDA-kernel legacy).
 
 from __future__ import annotations
 
+import warnings
 from typing import Optional
 
 import jax
@@ -79,6 +80,10 @@ def attention(
                 from megatron_tpu.ops.pallas.flash_attention import flash_attention
             except ImportError:
                 flash_attention = None
+                warnings.warn(
+                    "attention_impl='pallas' requested but the flash kernel "
+                    "is unavailable; falling back to the O(S^2) XLA path",
+                    stacklevel=2)
             if flash_attention is not None:
                 return flash_attention(q, k, v, sliding_window=sliding_window)
         # fall through to the XLA path for shapes/features the kernel

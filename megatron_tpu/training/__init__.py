@@ -1,0 +1,12 @@
+from megatron_tpu.training.scheduler import lr_at_step, wd_at_step
+from megatron_tpu.training.optimizer import TrainState, init_train_state, make_optimizer_step
+from megatron_tpu.training.train_step import make_train_step
+
+__all__ = [
+    "lr_at_step",
+    "wd_at_step",
+    "TrainState",
+    "init_train_state",
+    "make_optimizer_step",
+    "make_train_step",
+]

@@ -1,0 +1,62 @@
+"""Global-batch rampup / microbatch accounting.
+
+Equivalent of megatron/microbatches.py (144 LoC):
+ConstantNumMicroBatches and RampupBatchsizeNumMicroBatches behind one
+calculator. Rampup semantics match the reference: with
+(start, increment, ramp_samples), the global batch starts at `start` and
+steps up by `increment`; each intermediate size consumes an equal share of
+`ramp_samples` (ramp_samples / num_increments samples per level).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+from megatron_tpu.config import TrainingConfig
+
+
+@dataclasses.dataclass
+class MicroBatchCalculator:
+    micro_batch_size: int
+    target_global_batch: int
+    data_parallel: int
+    rampup: Optional[Tuple[int, int, int]] = None  # (start, incr, ramp_samples)
+
+    def __post_init__(self):
+        if self.target_global_batch % (self.micro_batch_size * self.data_parallel):
+            raise ValueError(
+                f"global_batch={self.target_global_batch} not divisible by "
+                f"micro_batch*dp={self.micro_batch_size * self.data_parallel}")
+        if self.rampup is not None:
+            start, incr, _ = self.rampup
+            if (self.target_global_batch - start) % incr:
+                raise ValueError("(global_batch - start) must be divisible by increment")
+            if start % (self.micro_batch_size * self.data_parallel):
+                raise ValueError("rampup start batch not divisible by micro_batch*dp")
+            if incr % (self.micro_batch_size * self.data_parallel):
+                raise ValueError("rampup increment not divisible by micro_batch*dp")
+
+    def global_batch(self, consumed_samples: int) -> int:
+        if self.rampup is None:
+            return self.target_global_batch
+        start, incr, ramp_samples = self.rampup
+        n_levels = (self.target_global_batch - start) // incr
+        if n_levels == 0:
+            return self.target_global_batch
+        per_level = ramp_samples // n_levels
+        level = min(consumed_samples // max(per_level, 1), n_levels)
+        return min(start + level * incr, self.target_global_batch)
+
+    def num_microbatches(self, consumed_samples: int) -> int:
+        return self.global_batch(consumed_samples) // (
+            self.micro_batch_size * self.data_parallel)
+
+    @staticmethod
+    def from_config(cfg: TrainingConfig, data_parallel: int) -> "MicroBatchCalculator":
+        return MicroBatchCalculator(
+            micro_batch_size=cfg.micro_batch_size,
+            target_global_batch=cfg.global_batch_size,
+            data_parallel=data_parallel,
+            rampup=cfg.rampup_batch_size,
+        )

@@ -1,0 +1,65 @@
+"""Learning-rate / weight-decay schedule.
+
+Equivalent of megatron/optimizer_param_scheduler.py (228 LoC): linear warmup
+followed by {constant, linear, cosine, inverse-square-root} decay, plus a
+weight-decay ramp. Here the schedule is a pure function of the step — it is
+traced into the train step, so there is no mutable scheduler object to
+checkpoint; resume restores the step counter and the schedule follows.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from megatron_tpu.config import OptimizerConfig
+
+
+def lr_at_step(cfg: OptimizerConfig, step, train_iters: int):
+    """LR for a (possibly traced) integer step. Mirrors
+    OptimizerParamScheduler.get_lr."""
+    step = jnp.asarray(step, jnp.float32)
+    warmup = jnp.asarray(
+        cfg.lr_warmup_iters
+        if cfg.lr_warmup_fraction is None
+        else cfg.lr_warmup_fraction * (cfg.lr_decay_iters or train_iters),
+        jnp.float32,
+    )
+    decay_steps = jnp.asarray(cfg.lr_decay_iters or train_iters, jnp.float32)
+    max_lr, min_lr = cfg.lr, cfg.min_lr
+
+    warmup_lr = max_lr * step / jnp.maximum(warmup, 1.0)
+
+    # progress through the decay window, clipped to [0, 1]
+    frac = jnp.clip((step - warmup) / jnp.maximum(decay_steps - warmup, 1.0), 0.0, 1.0)
+    if cfg.lr_decay_style == "constant":
+        decay_lr = jnp.asarray(max_lr, jnp.float32)
+    elif cfg.lr_decay_style == "linear":
+        decay_lr = max_lr + (min_lr - max_lr) * frac
+    elif cfg.lr_decay_style == "cosine":
+        decay_lr = min_lr + 0.5 * (max_lr - min_lr) * (1.0 + jnp.cos(jnp.pi * frac))
+    elif cfg.lr_decay_style == "inverse-square-root":
+        # matches the reference: lr * sqrt(warmup) / sqrt(step)
+        eff = jnp.maximum(step, warmup + 1.0)
+        decay_lr = max_lr * jnp.sqrt(jnp.maximum(warmup, 1.0)) / jnp.sqrt(eff)
+        decay_lr = jnp.maximum(decay_lr, min_lr)
+    else:
+        raise ValueError(f"unknown lr_decay_style {cfg.lr_decay_style!r}")
+
+    return jnp.where(step < warmup, warmup_lr, decay_lr)
+
+
+def wd_at_step(cfg: OptimizerConfig, step, train_iters: int):
+    """Weight-decay ramp (ref: start/end_weight_decay + incr style)."""
+    if cfg.start_weight_decay is None or cfg.end_weight_decay is None:
+        return jnp.asarray(cfg.weight_decay, jnp.float32)
+    step = jnp.asarray(step, jnp.float32)
+    total = jnp.asarray(cfg.lr_decay_iters or train_iters, jnp.float32)
+    frac = jnp.clip(step / jnp.maximum(total, 1.0), 0.0, 1.0)
+    w0, w1 = cfg.start_weight_decay, cfg.end_weight_decay
+    if cfg.weight_decay_incr_style == "constant":
+        return jnp.asarray(cfg.weight_decay, jnp.float32)
+    if cfg.weight_decay_incr_style == "linear":
+        return w0 + (w1 - w0) * frac
+    if cfg.weight_decay_incr_style == "cosine":
+        return w1 + 0.5 * (w0 - w1) * (1.0 + jnp.cos(jnp.pi * frac))
+    raise ValueError(f"unknown weight_decay_incr_style {cfg.weight_decay_incr_style!r}")

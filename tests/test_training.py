@@ -1,0 +1,187 @@
+"""Training-runtime tests: scheduler math, optimizer semantics (masters,
+clipping, skip-on-overflow, scaler), microbatch accumulation equivalence,
+loss goes down (counterpart of the reference's optimizer/scheduler units +
+its end-to-end sanity runs)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatron_tpu.config import OptimizerConfig, TrainingConfig
+from megatron_tpu.models import presets
+from megatron_tpu.models.params import init_params
+from megatron_tpu.training.microbatches import MicroBatchCalculator
+from megatron_tpu.training.optimizer import (
+    ScalerState, init_train_state, make_optimizer_step,
+)
+from megatron_tpu.training.scheduler import lr_at_step
+from megatron_tpu.training.train_step import make_train_step
+
+
+def test_lr_warmup_and_cosine():
+    cfg = OptimizerConfig(lr=1e-3, min_lr=1e-5, lr_warmup_iters=10,
+                          lr_decay_style="cosine")
+    assert float(lr_at_step(cfg, 0, 100)) == 0.0
+    np.testing.assert_allclose(float(lr_at_step(cfg, 5, 100)), 5e-4, rtol=1e-5)
+    np.testing.assert_allclose(float(lr_at_step(cfg, 10, 100)), 1e-3, rtol=1e-5)
+    np.testing.assert_allclose(float(lr_at_step(cfg, 100, 100)), 1e-5, rtol=1e-4)
+    mid = float(lr_at_step(cfg, 55, 100))
+    np.testing.assert_allclose(mid, (1e-3 + 1e-5) / 2, rtol=1e-3)
+
+
+def test_lr_styles():
+    for style in ["constant", "linear", "inverse-square-root"]:
+        cfg = OptimizerConfig(lr=1e-3, min_lr=0.0, lr_warmup_iters=5,
+                              lr_decay_style=style)
+        v = float(lr_at_step(cfg, 50, 100))
+        assert 0 <= v <= 1e-3 * (1 + 1e-6)
+
+
+def _tiny_setup(dtype="float32", **opt_kw):
+    cfg = presets.tiny(vocab_size=64, seq_length=16, params_dtype=dtype)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    opt_cfg = OptimizerConfig(lr=1e-2, lr_warmup_iters=0, lr_decay_style="constant",
+                              **opt_kw)
+    return cfg, params, opt_cfg
+
+
+def test_master_weights_created_for_bf16():
+    cfg, params, opt_cfg = _tiny_setup(dtype="bfloat16")
+    state = init_train_state(opt_cfg, params)
+    assert state.master is not None
+    assert all(x.dtype == jnp.float32 for x in jax.tree.leaves(state.master))
+    cfg, params, opt_cfg = _tiny_setup(dtype="float32")
+    state = init_train_state(opt_cfg, params)
+    assert state.master is None
+
+
+def test_optimizer_step_descends_quadratic():
+    """Adam on f(p) = |p|^2/2 drives p toward 0."""
+    params = {"w": jnp.ones((4, 4)) * 2.0}
+    opt_cfg = OptimizerConfig(lr=0.1, weight_decay=0.0, clip_grad=0.0,
+                              lr_decay_style="constant")
+    state = init_train_state(opt_cfg, params)
+    step = make_optimizer_step(opt_cfg, train_iters=100)
+    for _ in range(50):
+        grads = jax.tree.map(lambda p: p.astype(jnp.float32), state.params)
+        state, m = step(state, grads)
+    assert float(jnp.abs(state.params["w"]).max()) < 0.5
+    assert int(state.step) == 50
+
+
+def test_skip_on_nonfinite_grads():
+    params = {"w": jnp.ones((2, 2))}
+    opt_cfg = OptimizerConfig(lr=0.1, lr_decay_style="constant")
+    state = init_train_state(opt_cfg, params)
+    step = make_optimizer_step(opt_cfg, train_iters=10)
+    bad = {"w": jnp.full((2, 2), jnp.nan)}
+    new_state, metrics = step(state, bad)
+    assert float(metrics["skipped"]) == 1.0
+    np.testing.assert_array_equal(np.asarray(new_state.params["w"]),
+                                  np.asarray(state.params["w"]))
+    assert int(new_state.step) == 0
+
+
+def test_grad_clipping_applied():
+    params = {"w": jnp.ones((2, 2))}
+    opt_cfg = OptimizerConfig(lr=1.0, clip_grad=1.0, weight_decay=0.0,
+                              lr_decay_style="constant")
+    state = init_train_state(opt_cfg, params)
+    step = make_optimizer_step(opt_cfg, train_iters=10)
+    huge = {"w": jnp.full((2, 2), 1000.0)}
+    _, metrics = step(state, huge)
+    np.testing.assert_allclose(float(metrics["grad_norm"]), 2000.0, rtol=1e-4)
+
+
+def test_fp16_scaler_backoff_and_growth():
+    params = {"w": jnp.ones((2, 2), jnp.float16)}
+    opt_cfg = OptimizerConfig(lr=0.0, initial_loss_scale=2.0**10,
+                              loss_scale_window=2, hysteresis=1,
+                              lr_decay_style="constant")
+    state = init_train_state(opt_cfg, params, use_fp16_scaler=True)
+    step = make_optimizer_step(opt_cfg, train_iters=10)
+    assert float(state.scaler.scale) == 2.0**10
+    bad = {"w": jnp.full((2, 2), jnp.inf)}
+    state, m = step(state, bad)
+    assert float(state.scaler.scale) == 2.0**9  # backoff
+    good = {"w": jnp.ones((2, 2))}
+    state, _ = step(state, good)
+    state, _ = step(state, good)
+    assert float(state.scaler.scale) == 2.0**10  # growth after window
+
+
+def test_weight_decay_only_on_matrices():
+    opt_cfg = OptimizerConfig(lr=0.1, weight_decay=1.0, clip_grad=0.0,
+                              lr_decay_style="constant")
+    params = {"w": jnp.ones((2, 2)), "b": jnp.ones((2,))}
+    state = init_train_state(opt_cfg, params)
+    step = make_optimizer_step(opt_cfg, train_iters=10)
+    zero_g = jax.tree.map(jnp.zeros_like, params)
+    new_state, _ = step(state, zero_g)
+    # matrix decayed, vector untouched (zero grad, zero moments)
+    assert float(new_state.params["w"][0, 0]) < 1.0
+    np.testing.assert_allclose(np.asarray(new_state.params["b"]), 1.0)
+
+
+def test_train_step_microbatch_equivalence():
+    """1 microbatch of 8 == 4 microbatches of 2 (same grads).
+
+    Uses SGD so the param delta is linear in the gradient — Adam's
+    normalized update amplifies fp32 rounding near zero-gradient entries."""
+    cfg, params, opt_cfg = _tiny_setup(optimizer="sgd", sgd_momentum=0.0)
+    rng = np.random.default_rng(0)
+    batch = {
+        "tokens": jnp.asarray(rng.integers(0, 64, (8, 16)), jnp.int32),
+        "labels": jnp.asarray(rng.integers(0, 64, (8, 16)), jnp.int32),
+        "loss_mask": jnp.ones((8, 16), jnp.float32),
+    }
+    tcfg = TrainingConfig(micro_batch_size=2, global_batch_size=8)
+    s1 = init_train_state(opt_cfg, params)
+    s2 = init_train_state(opt_cfg, params)
+    step1 = make_train_step(cfg, opt_cfg, tcfg, num_microbatches=1, train_iters=10)
+    step4 = make_train_step(cfg, opt_cfg, tcfg, num_microbatches=4, train_iters=10)
+    s1, m1 = step1(s1, batch)
+    s2, m4 = step4(s2, batch)
+    np.testing.assert_allclose(float(m1["loss"]), float(m4["loss"]), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
+
+
+def test_loss_decreases_fitting_one_batch():
+    cfg, params, opt_cfg = _tiny_setup()
+    rng = np.random.default_rng(0)
+    batch = {
+        "tokens": jnp.asarray(rng.integers(0, 64, (4, 16)), jnp.int32),
+        "labels": jnp.asarray(rng.integers(0, 64, (4, 16)), jnp.int32),
+        "loss_mask": jnp.ones((4, 16), jnp.float32),
+    }
+    tcfg = TrainingConfig(micro_batch_size=4, global_batch_size=4)
+    state = init_train_state(opt_cfg, params)
+    step = jax.jit(make_train_step(cfg, opt_cfg, tcfg, num_microbatches=1,
+                                   train_iters=100))
+    first = None
+    for i in range(30):
+        state, metrics = step(state, batch)
+        if first is None:
+            first = float(metrics["loss"])
+    last = float(metrics["loss"])
+    assert last < first * 0.5, (first, last)
+
+
+def test_microbatch_calculator_rampup():
+    calc = MicroBatchCalculator(micro_batch_size=2, target_global_batch=16,
+                                data_parallel=1, rampup=(4, 4, 300))
+    # 3 levels (4->8->12->16), 100 samples each
+    assert calc.global_batch(0) == 4
+    assert calc.global_batch(99) == 4
+    assert calc.global_batch(100) == 8
+    assert calc.global_batch(250) == 12
+    assert calc.global_batch(10_000) == 16
+    assert calc.num_microbatches(0) == 2
+    assert calc.num_microbatches(10_000) == 8
+
+
+def test_microbatch_calculator_validation():
+    with pytest.raises(ValueError):
+        MicroBatchCalculator(micro_batch_size=3, target_global_batch=16, data_parallel=1)
