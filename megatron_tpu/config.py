@@ -32,6 +32,7 @@ GLU_ACTIVATIONS = ("geglu", "swiglu", "reglu", "liglu")
 # padding-mask plumbing exists end-to-end it is rejected rather than
 # silently training with future-token leakage.
 ATTN_MASK_TYPES = ("causal", "bidirectional")
+ATTENTION_IMPLS = ("xla", "pallas", "ring")
 RECOMPUTE_POLICIES = ("none", "selective", "full")
 DTYPES = {"bfloat16": jnp.bfloat16, "float16": jnp.float16, "float32": jnp.float32}
 
@@ -117,8 +118,9 @@ class ModelConfig:
     softmax_fp32: bool = True
     attn_mask_type: str = "causal"
 
-    # attention implementation: "pallas" flash kernel with fallback, or
-    # "xla" reference einsum path.
+    # attention implementation: "xla" einsum path, "pallas" flash kernel
+    # (falls back to xla for unsupported shapes), or "ring" context-parallel
+    # ring attention (requires an ambient mesh with a "context" axis).
     attention_impl: str = "xla"
 
     # ----- derived helpers -------------------------------------------------
@@ -158,6 +160,8 @@ class ModelConfig:
             raise ValueError(f"bad activation {self.activation}")
         if self.attn_mask_type not in ATTN_MASK_TYPES:
             raise ValueError(f"bad attn_mask_type {self.attn_mask_type}")
+        if self.attention_impl not in ATTENTION_IMPLS:
+            raise ValueError(f"bad attention_impl {self.attention_impl}")
         if self.hidden_size % self.num_attention_heads and self.kv_channels is None:
             raise ValueError("num_attention_heads must divide hidden_size")
         if self.num_attention_heads % self.n_kv_heads:

@@ -1,0 +1,26 @@
+from megatron_tpu.data.indexed_dataset import (
+    MMapIndexedDataset,
+    MMapIndexedDatasetBuilder,
+    make_builder,
+    make_dataset,
+)
+from megatron_tpu.data.gpt_dataset import GPTDataset, build_gpt_datasets
+from megatron_tpu.data.blendable_dataset import BlendableDataset
+from megatron_tpu.data.samplers import (
+    PretrainingSampler,
+    PretrainingRandomSampler,
+    build_data_loader,
+)
+
+__all__ = [
+    "MMapIndexedDataset",
+    "MMapIndexedDatasetBuilder",
+    "make_builder",
+    "make_dataset",
+    "GPTDataset",
+    "build_gpt_datasets",
+    "BlendableDataset",
+    "PretrainingSampler",
+    "PretrainingRandomSampler",
+    "build_data_loader",
+]

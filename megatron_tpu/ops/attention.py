@@ -68,6 +68,25 @@ def attention(
 
     q_offset: absolute position of q[0] (incremental decoding with KV cache).
     """
+    if impl == "ring":
+        # context-parallel exact attention; requires an ambient mesh with a
+        # "context" axis (jax.sharding.set_mesh) and no dropout/padding
+        can_use = (dropout == 0.0 and padding_mask is None
+                   and q.shape[1] == k.shape[1])
+        if can_use:
+            from megatron_tpu.ops.ring_attention import ring_attention_sharded
+            return ring_attention_sharded(
+                q, k, v, mesh=None, mask_type=mask_type,
+                sliding_window=sliding_window)
+        if dropout > 0.0 or padding_mask is not None:
+            # statically-known conflict: the O(S^2) fallback defeats the
+            # memory bound ring attention was chosen for
+            warnings.warn(
+                "attention_impl='ring' is incompatible with attention "
+                "dropout / padding masks; falling back to the O(S^2) XLA "
+                "path", stacklevel=2)
+        # decode steps (q_len != kv_len) fall through silently by design
+
     if impl == "pallas":
         can_use = (
             dropout == 0.0

@@ -36,9 +36,12 @@ def cross_entropy_loss(
     """
     logits = logits.astype(jnp.float32)
     lse = jax.nn.logsumexp(logits, axis=-1)                     # [B, S]
-    target_logit = jnp.take_along_axis(
-        logits, targets[..., None].astype(jnp.int32), axis=-1
-    )[..., 0]
+    # one-hot contraction instead of take_along_axis: gather-free, so the
+    # SPMD partitioner handles a vocab-sharded logits axis as a plain
+    # masked reduction (and XLA fuses the one-hot away)
+    vocab_iota = jnp.arange(logits.shape[-1], dtype=jnp.int32)
+    onehot = (targets[..., None].astype(jnp.int32) == vocab_iota)
+    target_logit = jnp.sum(jnp.where(onehot, logits, 0.0), axis=-1)
     loss = lse - target_logit
     if label_smoothing > 0.0:
         # smoothed CE: (1-eps)*nll + eps * mean over vocab of nll_v

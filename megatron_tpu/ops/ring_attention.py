@@ -1,0 +1,132 @@
+"""Ring attention over the "context" mesh axis.
+
+Long-context context parallelism — beyond reference parity (the reference
+has no CP/ring/Ulysses path; its only long-context levers are RoPE scaling
+and Korthikanti SP, see SURVEY.md §2.2/§5 — this is the capability its
+users would need next, built TPU-first).
+
+Mechanics (Liu et al., Ring Attention; blockwise online softmax):
+  * the sequence axis is sharded over "context"; each device keeps its
+    local Q block resident,
+  * K/V blocks rotate around the ring with lax.ppermute (collective-permute
+    rides the ICI torus neighbors), one hop per step,
+  * a streaming log-sum-exp accumulator merges each block's partial
+    attention, so the full [S, S] score matrix never materializes and
+    per-device memory is O(S_local^2 / cp) per step,
+  * causal masking uses global positions reconstructed from each block's
+    ring origin, so blocks entirely in the future contribute nothing.
+
+Used inside a partial-manual shard_map (context manual, data/tensor auto) —
+see megatron_tpu/models/transformer.py attention dispatch.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from megatron_tpu.parallel.mesh import AXIS_CONTEXT
+
+
+def _block_attention_step(q, k, v, bias, m_prev, l_prev, acc_prev):
+    """One online-softmax update. q:[B,Sq,Hkv,G,D] k/v:[B,Skv,Hkv,D],
+    bias:[Sq,Skv] additive fp32. Accumulators fp32."""
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", q, k)  # fp32
+    scores = scores + bias
+    m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1))
+    # guard -inf rows (fully masked so far) from producing nans
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(scores - m_safe[..., None])
+    p = jnp.where(jnp.isfinite(scores), p, 0.0)
+    correction = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe), 0.0)
+    l_new = l_prev * correction + jnp.sum(p, axis=-1)
+    acc_new = acc_prev * correction[..., None] + jnp.einsum(
+        "bhgqk,bkhd->bhgqd", p, v.astype(jnp.float32))
+    return m_new, l_new, acc_new
+
+
+def ring_attention(
+    q: jnp.ndarray,  # [B, Sq_local, Hq, D]  (inside shard_map, context manual)
+    k: jnp.ndarray,  # [B, Skv_local, Hkv, D]
+    v: jnp.ndarray,
+    axis_name: str = AXIS_CONTEXT,
+    mask_type: str = "causal",
+    sliding_window: Optional[int] = None,
+    softmax_fp32: bool = True,  # accepted for interface parity; always fp32
+) -> jnp.ndarray:
+    """Exact attention with K/V rotating around `axis_name`.
+
+    Returns [B, Sq_local, Hq, D]. Requires equal local seq lengths (the
+    mesh guarantees it).
+    """
+    del softmax_fp32
+    b, sq, hq, d = q.shape
+    _, skv, hkv, _ = k.shape
+    groups = hq // hkv
+    cp = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    qg = (q.astype(jnp.float32) * scale).reshape(b, sq, hkv, groups, d)
+
+    q_pos = my * sq + jnp.arange(sq)  # global positions of local queries
+
+    neg = jnp.float32(-jnp.inf)
+
+    def bias_for(src):
+        """Additive mask for kv block that originated on ring rank `src`."""
+        k_pos = src * skv + jnp.arange(skv)
+        allowed = jnp.ones((sq, skv), bool)
+        if mask_type == "causal":
+            allowed &= k_pos[None, :] <= q_pos[:, None]
+        if sliding_window is not None:
+            allowed &= k_pos[None, :] > q_pos[:, None] - sliding_window
+        return jnp.where(allowed, 0.0, neg)
+
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    def step(carry, r):
+        kc, vc, m, l, acc = carry
+        src = (my - r) % cp  # ring origin of the block currently held
+        bias = bias_for(src)
+        m, l, acc = _block_attention_step(
+            qg, kc.astype(jnp.float32), vc, bias, m, l, acc)
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        return (kc, vc, m, l, acc), None
+
+    m0 = jnp.full((b, hkv, groups, sq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, hkv, groups, sq), jnp.float32)
+    acc0 = jnp.zeros((b, hkv, groups, sq, d), jnp.float32)
+    (_, _, m, l, acc), _ = jax.lax.scan(
+        step, (k, v, m0, l0, acc0), jnp.arange(cp))
+
+    out = acc / jnp.maximum(l[..., None], 1e-30)
+    out = jnp.transpose(out, (0, 3, 1, 2, 4)).reshape(b, sq, hq, d)
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(
+    q: jnp.ndarray,  # [B, S, Hq, D] global (GSPMD view)
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh=None,
+    mask_type: str = "causal",
+    sliding_window: Optional[int] = None,
+) -> jnp.ndarray:
+    """GSPMD-callable wrapper: context axis manual, everything else auto.
+
+    mesh=None uses the ambient mesh (jax.sharding.set_mesh)."""
+    fn = jax.shard_map(
+        lambda q, k, v: ring_attention(
+            q, k, v, mask_type=mask_type, sliding_window=sliding_window),
+        mesh=mesh,
+        in_specs=(P(None, AXIS_CONTEXT), P(None, AXIS_CONTEXT), P(None, AXIS_CONTEXT)),
+        out_specs=P(None, AXIS_CONTEXT),
+        axis_names={AXIS_CONTEXT},
+        check_vma=False,
+    )
+    return fn(q, k, v)

@@ -1,0 +1,209 @@
+"""Pipeline parallelism: microbatch rotation over the "pipe" mesh axis.
+
+TPU-native replacement for megatron/schedules.py (722 LoC) +
+megatron/p2p_communication.py (405 LoC). The reference hand-writes a 1F1B
+schedule with batched NCCL isend/irecv, output-tensor deallocation and a
+direct call into the C++ autograd engine (schedules.py:36-88). Here the
+schedule is a forward-only program:
+
+  * the mesh "pipe" axis is manual (shard_map); each stage holds
+    layers[stage * Lp : (stage+1) * Lp] because the stacked layer params are
+    sharded over "pipe" on their leading axis,
+  * microbatches rotate stage-to-stage with lax.ppermute
+    (collective-permute rides ICI neighbors, like the reference's p2p ring),
+  * the *backward* schedule is not written at all: jax.grad of ppermute is
+    the reverse ppermute, so differentiating the forward loop yields the
+    cooldown phase, with stage bodies rematerialized (jax.checkpoint) so
+    live activation memory is one [mbs, S, H] buffer per in-flight
+    microbatch, the same bound the reference gets from 1F1B + recompute.
+  * other mesh axes (data/context/tensor) stay automatic: GSPMD keeps
+    handling TP/SP/DP inside each stage body.
+
+Embedding runs on every stage but feeds only stage 0 (a cheap gather);
+logits + loss run under lax.cond so only the last stage pays for them
+(ref: post_language_model_processing on the last stage, gpt_model.py:18).
+
+Schedule flavor is GPipe-with-remat rather than interleaved 1F1B; the
+warmup/steady/cooldown structure emerges from autodiff rather than being
+scheduled by hand. Virtual-pipeline interleaving (ref schedules.py:253-502)
+maps to sharding layers round-robin over "pipe" — not yet implemented.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from megatron_tpu.config import ModelConfig
+from megatron_tpu.models.language_model import (
+    _layer_dropout_rates, embed_tokens, lm_logits, _remat_policy,
+)
+from megatron_tpu.models.transformer import block_forward
+from megatron_tpu.ops.cross_entropy import cross_entropy_loss
+from megatron_tpu.ops.normalization import norm_forward
+from megatron_tpu.ops.rotary import precompute_rope
+
+
+def _stage_fn(cfg: ModelConfig, layers_local: Any, x: jnp.ndarray,
+              rope, positions, dropout_key, stage: jnp.ndarray,
+              layers_per_stage: int, recompute: str) -> jnp.ndarray:
+    """Run this stage's contiguous slice of layers (lax.scan over Lp)."""
+    rates_all = _layer_dropout_rates(cfg)  # [L] per-global-layer rates
+
+    def body(carry, scanned):
+        x = carry
+        lp, local_idx = scanned
+        global_idx = stage * layers_per_stage + local_idx
+        rate = rates_all[global_idx]
+        key = (jax.random.fold_in(dropout_key, global_idx)
+               if dropout_key is not None else None)
+        y, _ = block_forward(cfg, lp, x, rope, positions,
+                             dropout_key=key, hidden_dropout_rate=rate)
+        return y, None
+
+    policy = _remat_policy(recompute)
+    if policy is not None:
+        body = jax.checkpoint(body, policy=policy, prevent_cse=False)
+    x, _ = jax.lax.scan(body, x, (layers_local, jnp.arange(layers_per_stage)))
+    return x
+
+
+def make_pipeline_loss_fn(
+    model_cfg: ModelConfig,
+    mesh: Mesh,
+    num_stages: int,
+    num_microbatches: int,
+    recompute: str = "selective",
+):
+    """Returns loss_fn(params, batch, dropout_key) -> (mean_loss, ntokens).
+
+    batch leaves are [GB, S] with GB = num_microbatches * per-microbatch
+    rows; the pipeline consumes one microbatch per tick. Requires
+    num_layers % num_stages == 0.
+    """
+    Pn, M = num_stages, num_microbatches
+    L = model_cfg.num_layers
+    if L % Pn:
+        raise ValueError(f"num_layers={L} not divisible by pipeline stages {Pn}")
+    Lp = L // Pn
+    if M < 1:
+        raise ValueError("need at least one microbatch")
+
+    def loss_fn(params: Dict[str, Any], batch: Dict[str, jnp.ndarray],
+                dropout_key: Optional[jax.Array] = None):
+        tokens, labels = batch["tokens"], batch["labels"]
+        loss_mask = batch.get("loss_mask")
+        if loss_mask is None:
+            loss_mask = jnp.ones(labels.shape, jnp.float32)
+        gb, S = tokens.shape
+        mbs = gb // M
+        split = lambda x: x.reshape((M, mbs) + x.shape[1:])
+        tokens, labels, loss_mask = split(tokens), split(labels), split(loss_mask)
+
+        dropout_on = dropout_key is not None and (
+            model_cfg.hidden_dropout > 0 or model_cfg.attention_dropout > 0)
+
+        # Embed OUTSIDE the pipe-manual region: the vocab-sharded embedding
+        # gather stays in plain GSPMD land (the partial-manual partitioner
+        # chokes on sharded gathers), and stages don't redundantly re-embed.
+        # Embedding dropout matches lm_forward's keying (fold 0xE0B), with a
+        # per-microbatch fold so masks differ across microbatches.
+        if dropout_on and model_cfg.hidden_dropout > 0:
+            embed_keys = jax.vmap(
+                lambda i: jax.random.fold_in(
+                    jax.random.fold_in(dropout_key, 0xE0B), i)
+            )(jnp.arange(M))
+            embedded = jax.vmap(
+                lambda t, ek: embed_tokens(model_cfg, params, t, None,
+                                           dropout_key=ek)
+            )(tokens, embed_keys).astype(model_cfg.dtype)  # [M, mbs, S, H]
+        else:
+            embedded = jax.vmap(
+                lambda t: embed_tokens(model_cfg, params, t, None,
+                                       dropout_key=None)
+            )(tokens).astype(model_cfg.dtype)  # [M, mbs, S, H]
+
+        rope = None
+        if model_cfg.position_embedding_type == "rotary":
+            rope = precompute_rope(model_cfg.head_dim,
+                                   max(model_cfg.seq_length, S),
+                                   model_cfg.rope_theta,
+                                   model_cfg.rope_scaling_factor)
+
+        T = M + Pn - 1  # pipeline ticks
+
+        key_arg = dropout_key if dropout_on else jax.random.PRNGKey(0)
+
+        def pipelined(layers, other, embedded, labels, loss_mask, key):
+            params_local = dict(other, layers=layers)
+            stage = jax.lax.axis_index("pipe")
+            is_first = stage == 0
+            is_last = stage == Pn - 1
+
+            perm = [(i, (i + 1) % Pn) for i in range(Pn)]
+
+            def tick(carry, t):
+                state, loss_sum, tok_sum = carry
+                feed_idx = jnp.minimum(t, M - 1)
+                emb = embedded[feed_idx]
+                x = jnp.where(is_first & (t < M), emb, state)
+                mb_idx = t - stage  # which microbatch this stage works on
+                key_t = (jax.random.fold_in(key, mb_idx) if dropout_on else None)
+                out = _stage_fn(model_cfg, params_local["layers"], x, rope,
+                                None, key_t, stage, Lp, recompute)
+
+                # loss on the last stage once the first microbatch arrives
+                out_idx = jnp.maximum(t - (Pn - 1), 0)
+
+                def with_loss(_):
+                    h = norm_forward(model_cfg.normalization, out,
+                                     params_local["final_ln"]["scale"],
+                                     params_local["final_ln"].get("bias"),
+                                     model_cfg.layernorm_epsilon)
+                    logits = lm_logits(model_cfg, params_local, h)
+                    _, per_tok = cross_entropy_loss(logits, labels[out_idx])
+                    m = loss_mask[out_idx]
+                    return jnp.sum(per_tok * m), jnp.sum(m)
+
+                def without_loss(_):
+                    return jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)
+
+                lsum, lcnt = jax.lax.cond(
+                    is_last & (t >= Pn - 1), with_loss, without_loss, operand=None)
+
+                state = jax.lax.ppermute(out, "pipe", perm)
+                return (state, loss_sum + lsum, tok_sum + lcnt), None
+
+            h0 = jnp.zeros(
+                (mbs, S, model_cfg.hidden_size),
+                model_cfg.dtype,
+            )
+            (state, loss_sum, tok_sum), _ = jax.lax.scan(
+                tick, (h0, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+                jnp.arange(T))
+            loss_sum = jax.lax.psum(loss_sum, "pipe")
+            tok_sum = jax.lax.psum(tok_sum, "pipe")
+            return loss_sum / jnp.maximum(tok_sum, 1.0), tok_sum
+
+        other = {k: v for k, v in params.items() if k != "layers"}
+        in_specs = (
+            jax.tree.map(lambda _: P("pipe"), params["layers"]),
+            jax.tree.map(lambda _: P(), other),
+            P(), P(), P(), P(),
+        )
+        fn = jax.shard_map(
+            pipelined,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=(P(), P()),
+            axis_names={"pipe"},
+            check_vma=False,
+        )
+        mean_loss, ntokens = fn(params["layers"], other, embedded, labels,
+                                loss_mask, key_arg)
+        return mean_loss, {"lm_loss": mean_loss, "ntokens": ntokens}
+
+    return loss_fn

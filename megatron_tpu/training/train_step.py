@@ -37,6 +37,7 @@ def make_train_step(
     train_iters: Optional[int] = None,
     sharder: Sharder = _identity_sharder,
     loss_fn: Optional[Callable] = None,
+    pipeline_loss_fn: Optional[Callable] = None,
 ) -> Callable[[TrainState, Dict[str, jnp.ndarray]], Tuple[TrainState, Dict[str, jnp.ndarray]]]:
     """Build train_step(state, batch) -> (state, metrics).
 
@@ -45,6 +46,11 @@ def make_train_step(
     axis is split into scan microbatches. loss_fn defaults to lm_loss —
     entry points may substitute task losses (the reference's
     forward_step_func indirection, training.py pretrain(forward_step_func)).
+
+    With pipeline_loss_fn (from make_pipeline_loss_fn), the pipeline owns
+    the microbatch loop (the reference's 1F1B schedule vs the no-pipelining
+    path, schedules.py:18-33) and this step differentiates the whole batch
+    at once.
     """
     loss_fn = loss_fn or (lambda cfg, p, b, key: lm_loss(
         cfg, p, b, dropout_key=key, recompute=train_cfg.recompute_granularity,
@@ -52,6 +58,24 @@ def make_train_step(
     opt_apply = make_optimizer_step(opt_cfg, train_iters or train_cfg.train_iters or 1)
     dropout_on = model_cfg.hidden_dropout > 0 or model_cfg.attention_dropout > 0
     streams = RngStreams(train_cfg.seed)
+
+    if pipeline_loss_fn is not None:
+        def pp_train_step(state: TrainState, batch: Dict[str, jnp.ndarray]):
+            scale = (state.scaler.scale if state.scaler is not None
+                     else jnp.float32(1.0))
+            key = streams.dropout(state.step) if dropout_on else None
+
+            def scaled_loss(p):
+                loss, _ = pipeline_loss_fn(p, batch, key)
+                return loss * scale, loss
+
+            (_, loss), grads = jax.value_and_grad(scaled_loss, has_aux=True)(
+                state.params)
+            new_state, metrics = opt_apply(state, grads)
+            metrics["loss"] = loss
+            return new_state, metrics
+
+        return pp_train_step
 
     def train_step(state: TrainState, batch: Dict[str, jnp.ndarray]):
         n = num_microbatches
