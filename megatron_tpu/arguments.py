@@ -1,0 +1,280 @@
+"""CLI argument parsing with reference flag-name parity.
+
+Equivalent of megatron/arguments.py (1,103 LoC): the same flag names
+(underscored, like the reference's fork) parsed into typed RunConfig
+dataclasses instead of a mutable global namespace. validate_args'
+cross-flag invariants live in the dataclasses' validate() methods; the
+derivations (dp size, microbatches, params dtype) happen in build_mesh /
+MicroBatchCalculator at use sites.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional, Sequence
+
+from megatron_tpu.config import (
+    ModelConfig, OptimizerConfig, ParallelConfig, RunConfig, TrainingConfig,
+)
+
+
+def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="megatron_tpu",
+                                allow_abbrev=False)
+
+    g = p.add_argument_group("network size")
+    g.add_argument("--num_layers", type=int, default=None)
+    g.add_argument("--hidden_size", type=int, default=None)
+    g.add_argument("--num_attention_heads", type=int, default=None)
+    g.add_argument("--num_attention_heads_kv", type=int, default=None)
+    g.add_argument("--kv_channels", type=int, default=None)
+    g.add_argument("--ffn_hidden_size", type=int, default=None)
+    g.add_argument("--seq_length", type=int, default=2048)
+    g.add_argument("--max_position_embeddings", type=int, default=None)
+    g.add_argument("--vocab_size", type=int, default=32000)
+    g.add_argument("--make_vocab_size_divisible_by", type=int, default=128)
+    g.add_argument("--position_embedding_type", default="rotary",
+                   choices=["rotary", "absolute"])
+    g.add_argument("--rope_theta", type=float, default=10000.0)
+    g.add_argument("--rope_scaling_factor", type=float, default=1.0)
+    g.add_argument("--layernorm_epsilon", type=float, default=1e-5)
+    g.add_argument("--use_rms_norm", action="store_true")
+    g.add_argument("--glu_activation", default=None,
+                   choices=["swiglu", "geglu", "reglu", "liglu"])
+    g.add_argument("--parallel_attn", action="store_true")
+    g.add_argument("--parallel_layernorm", action="store_true")
+    g.add_argument("--use_bias", action="store_true")
+    g.add_argument("--tie_embed_logits", action="store_true")
+    g.add_argument("--sliding_window_size", type=int, default=None)
+    g.add_argument("--lima_dropout", action="store_true")
+    g.add_argument("--model_name", default=None,
+                   help="preset: llama/llama2/codellama/falcon/mistral/gpt2"
+                        " (optionally 'name-SIZE', e.g. llama2-7B)")
+    g.add_argument("--model_size", default=None)
+
+    g = p.add_argument_group("regularization")
+    g.add_argument("--hidden_dropout", type=float, default=0.0)
+    g.add_argument("--attention_dropout", type=float, default=0.0)
+    g.add_argument("--weight_decay", type=float, default=0.01)
+    g.add_argument("--start_weight_decay", type=float, default=None)
+    g.add_argument("--end_weight_decay", type=float, default=None)
+    g.add_argument("--weight_decay_incr_style", default="constant")
+    g.add_argument("--clip_grad", type=float, default=1.0)
+
+    g = p.add_argument_group("training")
+    g.add_argument("--micro_batch_size", type=int, default=1)
+    g.add_argument("--global_batch_size", type=int, default=None)
+    g.add_argument("--rampup_batch_size", nargs=3, type=int, default=None)
+    g.add_argument("--train_iters", type=int, default=None)
+    g.add_argument("--train_samples", type=int, default=None)
+    g.add_argument("--exit_interval", type=int, default=None)
+    g.add_argument("--exit_duration_in_mins", type=int, default=None)
+    g.add_argument("--seed", type=int, default=1234)
+    g.add_argument("--init_method_std", type=float, default=0.02)
+    g.add_argument("--recompute_granularity", default="none",
+                   choices=["none", "selective", "full"])
+    g.add_argument("--optimizer", default="adam", choices=["adam", "sgd"])
+    g.add_argument("--attention_impl", default="xla",
+                   choices=["xla", "pallas", "ring"])
+
+    g = p.add_argument_group("learning rate")
+    g.add_argument("--lr", type=float, default=3e-4)
+    g.add_argument("--min_lr", type=float, default=0.0)
+    g.add_argument("--lr_decay_style", default="cosine",
+                   choices=["constant", "linear", "cosine",
+                            "inverse-square-root"])
+    g.add_argument("--lr_decay_iters", type=int, default=None)
+    g.add_argument("--lr_warmup_iters", type=int, default=0)
+    g.add_argument("--lr_warmup_fraction", type=float, default=None)
+    g.add_argument("--adam_beta1", type=float, default=0.9)
+    g.add_argument("--adam_beta2", type=float, default=0.999)
+    g.add_argument("--adam_eps", type=float, default=1e-8)
+
+    g = p.add_argument_group("checkpointing")
+    g.add_argument("--save", default=None)
+    g.add_argument("--load", default=None)
+    g.add_argument("--save_interval", type=int, default=None)
+    g.add_argument("--load_iters", type=int, default=None)
+    g.add_argument("--finetune", action="store_true")
+    g.add_argument("--no_load_optim", action="store_true")
+    g.add_argument("--no_load_rng", action="store_true")
+
+    g = p.add_argument_group("mixed precision")
+    g.add_argument("--bf16", action="store_true")
+    g.add_argument("--fp16", action="store_true")
+    g.add_argument("--fp32", action="store_true")
+    g.add_argument("--loss_scale", type=float, default=None)
+    g.add_argument("--initial_loss_scale", type=float, default=2.0**32)
+    g.add_argument("--min_loss_scale", type=float, default=1.0)
+    g.add_argument("--loss_scale_window", type=int, default=1000)
+    g.add_argument("--hysteresis", type=int, default=2)
+
+    g = p.add_argument_group("distributed")
+    g.add_argument("--tensor_model_parallel_size", type=int, default=1)
+    g.add_argument("--pipeline_model_parallel_size", type=int, default=1)
+    g.add_argument("--context_parallel_size", type=int, default=1)
+    g.add_argument("--sequence_parallel", action="store_true")
+    g.add_argument("--use_distributed_optimizer", action="store_true")
+
+    g = p.add_argument_group("validation")
+    g.add_argument("--eval_interval", type=int, default=1000)
+    g.add_argument("--eval_iters", type=int, default=100)
+    g.add_argument("--metrics", nargs="*", default=[])
+
+    g = p.add_argument_group("data")
+    g.add_argument("--data_path", nargs="*", default=None)
+    g.add_argument("--split", default="969,30,1")
+    g.add_argument("--tokenizer_type", default="SentencePieceTokenizer")
+    g.add_argument("--vocab_file", default=None)
+    g.add_argument("--merges_file", default=None)
+    g.add_argument("--tokenizer_model", default=None)
+    g.add_argument("--data_cache_dir", default=None)
+    g.add_argument("--scalar_loss_mask", type=float, default=0.0)
+    g.add_argument("--variable_seq_lengths", action="store_true")
+    g.add_argument("--eod_mask_loss", action="store_true")
+
+    g = p.add_argument_group("logging")
+    g.add_argument("--log_interval", type=int, default=100)
+    g.add_argument("--tensorboard_dir", default=None)
+    g.add_argument("--wandb_logger", action="store_true")
+    g.add_argument("--timing_log_level", type=int, default=0)
+
+    if extra_args_provider is not None:
+        extra_args_provider(p)
+    return p
+
+
+def args_to_run_config(args) -> RunConfig:
+    from megatron_tpu.models import presets
+    from megatron_tpu.tokenizer import pad_vocab_size
+
+    if args.model_name:
+        name = args.model_name
+        size = args.model_size
+        if "-" in name and size is None:
+            name, size = name.split("-", 1)
+        kw = {}
+        if size:
+            kw["size"] = size
+        model = presets.PRESETS[name](**kw)
+        # CLI overrides on top of the preset
+        overrides = {}
+        if args.seq_length and args.seq_length != 2048:
+            overrides["seq_length"] = args.seq_length
+        if args.rope_scaling_factor != 1.0:
+            overrides["rope_scaling_factor"] = args.rope_scaling_factor
+        overrides["hidden_dropout"] = args.hidden_dropout
+        overrides["attention_dropout"] = args.attention_dropout
+        overrides["lima_dropout"] = args.lima_dropout
+        overrides["attention_impl"] = args.attention_impl
+        overrides["params_dtype"] = _dtype_name(args)
+        model = ModelConfig(**{**model.__dict__, **overrides}).validate()
+    else:
+        required = ["num_layers", "hidden_size", "num_attention_heads"]
+        missing = [r for r in required if getattr(args, r) is None]
+        if missing:
+            raise ValueError(f"missing required model args: {missing} "
+                             "(or use --model_name)")
+        vocab = pad_vocab_size(args.vocab_size,
+                               args.make_vocab_size_divisible_by,
+                               args.tensor_model_parallel_size)
+        model = ModelConfig(
+            num_layers=args.num_layers,
+            hidden_size=args.hidden_size,
+            num_attention_heads=args.num_attention_heads,
+            num_kv_heads=args.num_attention_heads_kv,
+            kv_channels=args.kv_channels,
+            ffn_hidden_size=args.ffn_hidden_size,
+            vocab_size=vocab,
+            seq_length=args.seq_length,
+            max_position_embeddings=args.max_position_embeddings,
+            position_embedding_type=args.position_embedding_type,
+            rope_theta=args.rope_theta,
+            rope_scaling_factor=args.rope_scaling_factor,
+            normalization="rmsnorm" if args.use_rms_norm else "layernorm",
+            layernorm_epsilon=args.layernorm_epsilon,
+            activation=args.glu_activation or "gelu",
+            parallel_attn=args.parallel_attn,
+            parallel_layernorm=args.parallel_layernorm,
+            use_bias_linear=args.use_bias,
+            use_bias_qkv=args.use_bias,
+            tie_embed_logits=args.tie_embed_logits,
+            sliding_window_size=args.sliding_window_size,
+            hidden_dropout=args.hidden_dropout,
+            attention_dropout=args.attention_dropout,
+            lima_dropout=args.lima_dropout,
+            init_method_std=args.init_method_std,
+            params_dtype=_dtype_name(args),
+            attention_impl=args.attention_impl,
+        ).validate()
+
+    parallel = ParallelConfig(
+        tensor_parallel=args.tensor_model_parallel_size,
+        pipeline_parallel=args.pipeline_model_parallel_size,
+        context_parallel=args.context_parallel_size,
+        sequence_parallel=args.sequence_parallel,
+    ).validate()
+
+    optimizer = OptimizerConfig(
+        optimizer=args.optimizer,
+        lr=args.lr, min_lr=args.min_lr,
+        lr_decay_style=args.lr_decay_style,
+        lr_decay_iters=args.lr_decay_iters,
+        lr_warmup_iters=args.lr_warmup_iters,
+        lr_warmup_fraction=args.lr_warmup_fraction,
+        adam_beta1=args.adam_beta1, adam_beta2=args.adam_beta2,
+        adam_eps=args.adam_eps,
+        weight_decay=args.weight_decay,
+        start_weight_decay=args.start_weight_decay,
+        end_weight_decay=args.end_weight_decay,
+        weight_decay_incr_style=args.weight_decay_incr_style,
+        clip_grad=args.clip_grad,
+        use_distributed_optimizer=args.use_distributed_optimizer,
+        loss_scale=args.loss_scale,
+        initial_loss_scale=args.initial_loss_scale,
+        min_loss_scale=args.min_loss_scale,
+        loss_scale_window=args.loss_scale_window,
+        hysteresis=args.hysteresis,
+    )
+
+    training = TrainingConfig(
+        micro_batch_size=args.micro_batch_size,
+        global_batch_size=args.global_batch_size or args.micro_batch_size,
+        rampup_batch_size=tuple(args.rampup_batch_size)
+        if args.rampup_batch_size else None,
+        train_iters=args.train_iters,
+        train_samples=args.train_samples,
+        eval_interval=args.eval_interval,
+        eval_iters=args.eval_iters,
+        seed=args.seed,
+        recompute_granularity=args.recompute_granularity,
+        save=args.save, load=args.load,
+        save_interval=args.save_interval,
+        exit_interval=args.exit_interval,
+        exit_duration_in_mins=args.exit_duration_in_mins,
+        finetune=args.finetune,
+        no_load_optim=args.no_load_optim,
+        no_load_rng=args.no_load_rng,
+        log_interval=args.log_interval,
+        tensorboard_dir=args.tensorboard_dir,
+        wandb_logger=args.wandb_logger,
+        timing_log_level=args.timing_log_level,
+        scalar_loss_mask=args.scalar_loss_mask,
+        variable_seq_lengths=args.variable_seq_lengths,
+    ).validate()
+
+    return RunConfig(model=model, parallel=parallel, optimizer=optimizer,
+                     training=training).validate()
+
+
+def _dtype_name(args) -> str:
+    if getattr(args, "fp16", False):
+        return "float16"
+    if getattr(args, "fp32", False):
+        return "float32"
+    return "bfloat16"
+
+
+def parse_args(argv: Optional[Sequence[str]] = None, extra_args_provider=None):
+    parser = build_parser(extra_args_provider)
+    return parser.parse_args(argv)

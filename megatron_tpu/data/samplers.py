@@ -1,0 +1,105 @@
+"""Deterministic data-parallel samplers + a numpy batch loader.
+
+Equivalent of megatron/data/data_samplers.py (187 LoC). The reference wraps
+torch DataLoader; here the loader is a plain Python iterator producing
+numpy dicts — device placement happens at the train loop where shardings
+are known. Resume-exactness contract is identical: the sampler is a pure
+function of consumed_samples, so restoring that one integer reproduces the
+data order (ref: data_samplers.py:49-95 and checkpoint consumed_samples).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+
+class PretrainingSampler:
+    """Sequential sampler: each global batch is a contiguous range of
+    sample ids; this DP rank takes its slice."""
+
+    def __init__(self, total_samples: int, consumed_samples: int,
+                 micro_batch_size: int, data_parallel_rank: int,
+                 data_parallel_size: int, drop_last: bool = True):
+        if total_samples <= 0:
+            raise ValueError("no samples to consume")
+        if data_parallel_rank >= data_parallel_size:
+            raise ValueError("data_parallel_rank out of range")
+        self.total_samples = total_samples
+        self.consumed_samples = consumed_samples
+        self.micro_batch_size = micro_batch_size
+        self.dp_rank = data_parallel_rank
+        self.dp_size = data_parallel_size
+        self.micro_batch_times_dp = micro_batch_size * data_parallel_size
+        self.drop_last = drop_last
+
+    def __iter__(self) -> Iterator[list]:
+        batch = []
+        for idx in range(self.consumed_samples, self.total_samples):
+            batch.append(idx)
+            if len(batch) == self.micro_batch_times_dp:
+                start = self.dp_rank * self.micro_batch_size
+                yield batch[start:start + self.micro_batch_size]
+                batch = []
+        if batch and not self.drop_last:
+            start = self.dp_rank * self.micro_batch_size
+            yield batch[start:start + self.micro_batch_size]
+
+
+class PretrainingRandomSampler:
+    """Epoch-seeded random order with exact resume inside an epoch
+    (ref: MegatronPretrainingRandomSampler)."""
+
+    def __init__(self, total_samples: int, consumed_samples: int,
+                 micro_batch_size: int, data_parallel_rank: int,
+                 data_parallel_size: int, seed: int = 1234):
+        self.total_samples = total_samples
+        self.consumed_samples = consumed_samples
+        self.micro_batch_size = micro_batch_size
+        self.dp_rank = data_parallel_rank
+        self.dp_size = data_parallel_size
+        self.micro_batch_times_dp = micro_batch_size * data_parallel_size
+        self.last_batch_size = self.total_samples % self.micro_batch_times_dp
+        self.seed = seed
+
+    def __iter__(self) -> Iterator[list]:
+        active_total = self.total_samples - self.last_batch_size
+        epoch = self.consumed_samples // active_total
+        current_epoch_samples = self.consumed_samples % active_total
+        assert current_epoch_samples % self.micro_batch_times_dp == 0
+
+        bucket_size = (active_total // self.micro_batch_times_dp) \
+            * self.micro_batch_size
+        bucket_offset = current_epoch_samples // self.dp_size
+        start = self.dp_rank * bucket_size
+
+        g = np.random.RandomState(self.seed + epoch)
+        random_idx = g.permutation(bucket_size) + start
+        idx_range = random_idx[bucket_offset:]
+
+        batch = []
+        for idx in idx_range:
+            batch.append(int(idx))
+            if len(batch) == self.micro_batch_size:
+                yield batch
+                batch = []
+
+
+def build_data_loader(
+    dataset,
+    sampler,
+    collate_fn=None,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Yield collated numpy batches forever (re-iterating the sampler after
+    exhaustion, with consumed_samples advanced by the caller via sampler
+    state)."""
+    def default_collate(items):
+        out: Dict[str, np.ndarray] = {}
+        for k in items[0]:
+            out[k] = np.stack([it[k] for it in items])
+        return out
+
+    collate = collate_fn or default_collate
+    for idx_batch in sampler:
+        yield collate([dataset[i] for i in idx_batch])

@@ -18,6 +18,12 @@ Mechanics (Liu et al., Ring Attention; blockwise online softmax):
 
 Used inside a partial-manual shard_map (context manual, data/tensor auto) —
 see megatron_tpu/models/transformer.py attention dispatch.
+
+Known perf gap (correct but unbalanced): with contiguous sequence sharding
+and a causal mask, late ranks do ~cp times the useful work of rank 0 while
+every rank pays full einsum cost on fully-masked future blocks. The fix is
+zig-zag/striped position assignment so each rank holds an early+late stripe;
+planned, tracked for a later round.
 """
 
 from __future__ import annotations

@@ -1,0 +1,21 @@
+"""Platform selection helper.
+
+Some hosting environments pre-import jax via sitecustomize and pin
+JAX_PLATFORMS to a TPU plugin before user code runs, so the standard env
+var cannot force CPU for tests/CI. MEGATRON_TPU_FORCE_PLATFORM wins if set:
+entry points call ensure_platform() before touching any jax API that would
+initialize a backend.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def ensure_platform() -> None:
+    forced = os.environ.get("MEGATRON_TPU_FORCE_PLATFORM")
+    if not forced:
+        return
+    import jax
+
+    jax.config.update("jax_platforms", forced)

@@ -1,0 +1,7 @@
+from megatron_tpu.tokenizer.tokenizer import (
+    AbstractTokenizer,
+    build_tokenizer,
+    pad_vocab_size,
+)
+
+__all__ = ["AbstractTokenizer", "build_tokenizer", "pad_vocab_size"]

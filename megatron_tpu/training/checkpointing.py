@@ -1,0 +1,144 @@
+"""Checkpoint save/load: sharded, resharding-free.
+
+Equivalent of megatron/checkpointing.py (740 LoC) with the layout the
+reference uses (`<save>/iter_{it:07d}/` + `latest_checkpointed_iteration.txt`
+tracker) but a fundamentally different content model:
+
+  * One LOGICAL checkpoint via orbax (tensors + sharding metadata) instead
+    of per-(tp,pp)-rank torch pickles (mp_rank_XX folders) — a checkpoint
+    written at any topology loads at any other, which deletes the
+    reference's entire offline reshard tool-chain
+    (tools/checkpoint_util.py + loader/saver plugins, 907 LoC).
+  * No rng blobs: dropout/init streams are pure functions of (seed, step)
+    (megatron_tpu/parallel/random.py), so restoring the step restores the
+    randomness the reference saves as five generator states
+    (checkpointing.py:217-240).
+  * Run config is stored as JSON next to the weights (the reference pickles
+    the argparse namespace inside the .pt, checkpointing.py:267-285) and is
+    checked on load (check_checkpoint_args equivalent).
+
+Flags mirror the reference: --finetune (weights only, iteration reset),
+--no_load_optim, --load at a specific iteration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from megatron_tpu.training.optimizer import TrainState
+
+TRACKER = "latest_checkpointed_iteration.txt"
+
+
+def checkpoint_dir(save: str, iteration: int) -> str:
+    return os.path.join(os.path.abspath(save), f"iter_{iteration:07d}")
+
+
+def read_tracker(load: str) -> Optional[int]:
+    path = os.path.join(load, TRACKER)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        content = f.read().strip()
+    return int(content)
+
+
+def save_checkpoint(
+    save: str,
+    state: TrainState,
+    iteration: int,
+    consumed_samples: int = 0,
+    config: Optional[Dict[str, Any]] = None,
+) -> str:
+    """Write state + metadata, then atomically bump the tracker
+    (ref: save_checkpoint, checkpointing.py:243-337)."""
+    path = checkpoint_dir(save, iteration)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.join(path, "state"), state, force=True)
+    ckptr.wait_until_finished()
+    meta = {
+        "iteration": int(iteration),
+        "consumed_train_samples": int(consumed_samples),
+        "checkpoint_version": "tpu-1.0",
+        "config": config or {},
+    }
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=1)
+    tracker_tmp = os.path.join(os.path.abspath(save), TRACKER + ".tmp")
+    with open(tracker_tmp, "w") as f:
+        f.write(str(iteration))
+    os.replace(tracker_tmp, os.path.join(os.path.abspath(save), TRACKER))
+    return path
+
+
+def _abstract_like(state: TrainState, shardings=None) -> TrainState:
+    if shardings is None:
+        return jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state)
+    return jax.tree.map(
+        lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+        state, shardings)
+
+
+def load_checkpoint(
+    load: str,
+    state_template: TrainState,
+    iteration: Optional[int] = None,
+    shardings=None,
+    finetune: bool = False,
+    no_load_optim: bool = False,
+) -> Tuple[TrainState, int, int]:
+    """Restore (state, iteration, consumed_samples).
+
+    state_template provides structure/shapes/dtypes (typically the freshly
+    initialized TrainState); shardings (same structure) places restored
+    arrays directly onto the mesh — loading at a different topology than
+    the save is just different shardings here.
+
+    finetune: restore model weights only, reset iteration/optimizer
+    (ref: --finetune, checkpointing.py:634-687).
+    """
+    it = iteration if iteration is not None else read_tracker(load)
+    if it is None:
+        raise FileNotFoundError(f"no checkpoint tracker in {load}")
+    path = checkpoint_dir(load, it)
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+
+    ckptr = ocp.StandardCheckpointer()
+    abstract = _abstract_like(state_template, shardings)
+    restored: TrainState = ckptr.restore(os.path.join(path, "state"), abstract)
+
+    if finetune or no_load_optim:
+        restored = dataclasses.replace(
+            restored,
+            master=state_template.master,
+            mu=state_template.mu,
+            nu=state_template.nu,
+            scaler=state_template.scaler,
+        )
+        if finetune:
+            restored = dataclasses.replace(restored, step=state_template.step)
+            return restored, 0, 0
+    return restored, int(meta["iteration"]), int(meta["consumed_train_samples"])
+
+
+def check_config_compatibility(saved: Dict[str, Any], current: Dict[str, Any]):
+    """Architecture keys must match to resume (ref: check_checkpoint_args)."""
+    saved_model = saved.get("model", {})
+    current_model = current.get("model", {})
+    critical = ("num_layers", "hidden_size", "num_attention_heads",
+                "num_kv_heads", "ffn_hidden_size", "vocab_size")
+    for k in critical:
+        if k in saved_model and saved_model.get(k) != current_model.get(k):
+            raise ValueError(
+                f"checkpoint/config mismatch on {k}: "
+                f"{saved_model.get(k)} vs {current_model.get(k)}")

@@ -49,7 +49,8 @@ from megatron_tpu.ops.rotary import precompute_rope
 
 def _stage_fn(cfg: ModelConfig, layers_local: Any, x: jnp.ndarray,
               rope, positions, dropout_key, stage: jnp.ndarray,
-              layers_per_stage: int, recompute: str) -> jnp.ndarray:
+              layers_per_stage: int, recompute: str,
+              sharder=None) -> jnp.ndarray:
     """Run this stage's contiguous slice of layers (lax.scan over Lp)."""
     rates_all = _layer_dropout_rates(cfg)  # [L] per-global-layer rates
 
@@ -61,7 +62,8 @@ def _stage_fn(cfg: ModelConfig, layers_local: Any, x: jnp.ndarray,
         key = (jax.random.fold_in(dropout_key, global_idx)
                if dropout_key is not None else None)
         y, _ = block_forward(cfg, lp, x, rope, positions,
-                             dropout_key=key, hidden_dropout_rate=rate)
+                             dropout_key=key, hidden_dropout_rate=rate,
+                             **({"sharder": sharder} if sharder else {}))
         return y, None
 
     policy = _remat_policy(recompute)
@@ -77,6 +79,7 @@ def make_pipeline_loss_fn(
     num_stages: int,
     num_microbatches: int,
     recompute: str = "selective",
+    sharder=None,
 ):
     """Returns loss_fn(params, batch, dropout_key) -> (mean_loss, ntokens).
 
@@ -153,7 +156,8 @@ def make_pipeline_loss_fn(
                 mb_idx = t - stage  # which microbatch this stage works on
                 key_t = (jax.random.fold_in(key, mb_idx) if dropout_on else None)
                 out = _stage_fn(model_cfg, params_local["layers"], x, rope,
-                                None, key_t, stage, Lp, recompute)
+                                None, key_t, stage, Lp, recompute,
+                                sharder=sharder)
 
                 # loss on the last stage once the first microbatch arrives
                 out_idx = jnp.maximum(t - (Pn - 1), 0)

@@ -1,0 +1,310 @@
+"""Training orchestration: the pretrain()/train loop.
+
+Equivalent of megatron/training.py (966 LoC): setup -> train loop with
+batch-size rampup, periodic eval, logging, checkpointing, graceful exit
+(SIGTERM / --exit_duration_in_mins / --exit_interval). Differences:
+
+  * single-controller: no rank-conditional printing/broadcasts; the loop
+    body is one jitted train step with explicit shardings
+  * the data iterator yields numpy global batches; device placement happens
+    here with the batch PartitionSpec
+  * tokens/sec and MFU are derived from the model FLOP estimate
+    (ModelConfig.flops_per_token_fwd, ref language_model.py:370-384)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from megatron_tpu.config import RunConfig
+from megatron_tpu.models.language_model import lm_loss
+from megatron_tpu.models.params import init_params, param_specs
+from megatron_tpu.parallel.mesh import MeshRuntime, build_mesh
+from megatron_tpu.parallel.sharding import (
+    activation_spec, batch_spec, constrain, shard_tree, tree_shardings,
+)
+from megatron_tpu.training import checkpointing
+from megatron_tpu.training.microbatches import MicroBatchCalculator
+from megatron_tpu.training.optimizer import (
+    TrainState, init_train_state, train_state_specs,
+)
+from megatron_tpu.training.pipeline import make_pipeline_loss_fn
+from megatron_tpu.training.signal_handler import DistributedSignalHandler
+from megatron_tpu.training.timers import Timers
+from megatron_tpu.training.train_step import make_eval_step, make_train_step
+
+
+def get_ltor_masks_and_position_ids(
+    tokens: np.ndarray,
+    eod_token: Optional[int] = None,
+    reset_position_ids: bool = False,
+    eod_mask_loss: bool = False,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """(loss_mask, position_ids) for left-to-right LM batches
+    (ref: megatron/utils.py get_ltor_masks_and_position_ids; the
+    block-diagonal attention-mask reset is handled by packed position ids +
+    causal masking rather than a materialized [S,S] mask)."""
+    b, s = tokens.shape
+    loss_mask = np.ones((b, s), np.float32)
+    if eod_mask_loss and eod_token is not None:
+        loss_mask[tokens == eod_token] = 0.0
+    position_ids = np.tile(np.arange(s, dtype=np.int64), (b, 1))
+    if reset_position_ids and eod_token is not None:
+        for i in range(b):
+            for j in np.nonzero(tokens[i] == eod_token)[0]:
+                if j + 1 < s:
+                    position_ids[i, j + 1:] = np.arange(s - (j + 1))
+    return loss_mask, position_ids
+
+
+def gpt_collate(items, eod_token=None, eod_mask_loss=False):
+    """'text' [seq+1] items -> tokens/labels/loss_mask batch."""
+    text = np.stack([it["text"] for it in items]).astype(np.int64)
+    tokens, labels = text[:, :-1], text[:, 1:]
+    loss_mask, _ = get_ltor_masks_and_position_ids(
+        labels, eod_token, eod_mask_loss=eod_mask_loss)
+    return {"tokens": tokens, "labels": labels, "loss_mask": loss_mask}
+
+
+class TrainLoop:
+    """Owns mesh, state, jitted steps, and the iteration loop."""
+
+    def __init__(
+        self,
+        run_cfg: RunConfig,
+        log: Callable[[str], None] = print,
+    ):
+        run_cfg.validate()
+        self.cfg = run_cfg
+        self.log = log
+        self.rt: MeshRuntime = build_mesh(run_cfg.parallel)
+        self.timers = Timers(run_cfg.training.timing_log_level)
+
+        model_cfg = run_cfg.model
+        self.specs = param_specs(model_cfg)
+        params = init_params(model_cfg, jax.random.fold_in(
+            jax.random.PRNGKey(run_cfg.training.seed), 0))
+        params = shard_tree(self.rt, params, self.specs)
+        self.state = init_train_state(
+            run_cfg.optimizer, params,
+            use_fp16_scaler=(model_cfg.params_dtype == "float16"))
+
+        zero1 = run_cfg.optimizer.use_distributed_optimizer
+        self.state_specs = train_state_specs(self.specs, params, self.rt.dp,
+                                             zero1=zero1)
+        self.state_shardings = jax.tree.map(
+            lambda s: NamedSharding(self.rt.mesh, s), self.state_specs,
+            is_leaf=lambda s: isinstance(s, P))
+        self.batch_sharding = NamedSharding(self.rt.mesh, batch_spec())
+
+        self.calc = MicroBatchCalculator.from_config(run_cfg.training, self.rt.dp)
+        self.iteration = 0
+        self.consumed_samples = 0
+
+        if run_cfg.training.load:
+            self._load()
+
+        sp = run_cfg.parallel.sequence_parallel
+
+        def sharder(x, role):
+            if role == "residual":
+                return constrain(x, activation_spec(sp))
+            return x
+
+        self._sharder = sharder
+        self._step_cache: Dict[int, Callable] = {}
+        self.eval_step = None
+
+    # -- checkpoint ---------------------------------------------------------
+
+    def _load(self):
+        t = self.cfg.training
+        try:
+            state, it, consumed = checkpointing.load_checkpoint(
+                t.load, self.state, shardings=self.state_shardings,
+                finetune=t.finetune, no_load_optim=t.no_load_optim)
+        except FileNotFoundError:
+            self.log(f"no checkpoint found in {t.load}, starting fresh")
+            return
+        self.state = state
+        self.iteration = it
+        self.consumed_samples = consumed
+        self.log(f"loaded checkpoint at iteration {it} "
+                 f"(consumed {consumed} samples)")
+
+    def save(self):
+        t = self.cfg.training
+        if not t.save:
+            return
+        path = checkpointing.save_checkpoint(
+            t.save, self.state, self.iteration, self.consumed_samples,
+            config=self.cfg.to_dict())
+        self.log(f"saved checkpoint to {path}")
+
+    # -- steps --------------------------------------------------------------
+
+    def _train_step_for(self, num_microbatches: int) -> Callable:
+        """Jitted step per microbatch count (rampup re-jits per level,
+        like the reference re-deriving num_microbatches per iteration)."""
+        if num_microbatches not in self._step_cache:
+            pp = self.rt.pp
+            pp_loss_fn = None
+            if pp > 1:
+                pp_loss_fn = make_pipeline_loss_fn(
+                    self.cfg.model, self.rt.mesh, pp, num_microbatches,
+                    recompute=self.cfg.training.recompute_granularity,
+                    sharder=self._sharder)
+            step = make_train_step(
+                self.cfg.model, self.cfg.optimizer, self.cfg.training,
+                num_microbatches=num_microbatches,
+                train_iters=self.cfg.training.train_iters or 1,
+                sharder=self._sharder,
+                pipeline_loss_fn=pp_loss_fn)
+            self._step_cache[num_microbatches] = jax.jit(
+                step,
+                in_shardings=(self.state_shardings, self.batch_sharding),
+                donate_argnums=(0,))
+        return self._step_cache[num_microbatches]
+
+    def _put_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, jnp.ndarray]:
+        return {k: jax.device_put(v, self.batch_sharding)
+                for k, v in batch.items()}
+
+    def train_step(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        gbs = batch["tokens"].shape[0]
+        n_micro = gbs // (self.cfg.training.micro_batch_size * self.rt.dp)
+        step = self._train_step_for(max(n_micro, 1))
+        with jax.sharding.set_mesh(self.rt.mesh):
+            self.state, metrics = step(self.state, self._put_batch(batch))
+        self.iteration += 1
+        self.consumed_samples += gbs
+        return metrics
+
+    def evaluate(self, data_iter: Iterator, eval_iters: int) -> Dict[str, float]:
+        """Forward-only eval (ref: training.py:773-826)."""
+        if self.eval_step is None:
+            es = make_eval_step(self.cfg.model, self.cfg.training,
+                                sharder=self._sharder)
+            self.eval_step = jax.jit(es)
+        total, count = 0.0, 0
+        with jax.sharding.set_mesh(self.rt.mesh):
+            for _ in range(eval_iters):
+                batch = next(data_iter, None)
+                if batch is None:
+                    break
+                out = self.eval_step(self.state.params, self._put_batch(batch))
+                total += float(out["lm_loss"])
+                count += 1
+        loss = total / max(count, 1)
+        return {"lm_loss": loss, "ppl": float(np.exp(min(loss, 20.0)))}
+
+    # -- loop ---------------------------------------------------------------
+
+    def train(
+        self,
+        train_iter_factory: Callable[[int, int], Iterator[Dict[str, np.ndarray]]],
+        valid_iter_factory: Optional[Callable[[], Iterator]] = None,
+    ) -> TrainState:
+        """train_iter_factory(consumed_samples, global_batch) returns an
+        iterator of global batches at that batch size (rampup-aware)."""
+        t = self.cfg.training
+        model_flops_per_token = 3.0 * self.cfg.model.flops_per_token_fwd()
+        start_time = time.time()
+        window_tokens = 0
+        window_t0 = time.time()
+        loss_avg, loss_n = 0.0, 0
+
+        last_saved = None
+        with DistributedSignalHandler() as sig:
+            data_iter = None
+            current_gbs = None
+            while self.iteration < (t.train_iters or 0):
+                gbs = self.calc.global_batch(self.consumed_samples)
+                if gbs != current_gbs or data_iter is None:
+                    current_gbs = gbs
+                    data_iter = train_iter_factory(self.consumed_samples, gbs)
+
+                batch = next(data_iter, None)
+                if batch is None:
+                    # epoch boundary: ask the factory for a fresh iterator
+                    # (sampler order is a pure function of consumed_samples)
+                    data_iter = train_iter_factory(self.consumed_samples, gbs)
+                    batch = next(data_iter, None)
+                    if batch is None:
+                        self.log("data exhausted, stopping")
+                        break
+
+                self.timers("step", 0).start()
+                metrics = self.train_step(batch)
+                loss_host = float(metrics["loss"])  # host sync
+                self.timers("step", 0).stop()
+
+                ntok = batch["tokens"].size
+                window_tokens += ntok
+                loss_avg += loss_host
+                loss_n += 1
+
+                if self.iteration % t.log_interval == 0:
+                    dt = time.time() - window_t0
+                    tps = window_tokens / max(dt, 1e-9)
+                    mfu_flops = tps * model_flops_per_token
+                    self.log(
+                        f"iteration {self.iteration}/{t.train_iters} | "
+                        f"consumed samples: {self.consumed_samples} | "
+                        f"lm loss: {loss_avg / max(loss_n, 1):.6f} | "
+                        f"lr: {float(metrics['lr']):.3e} | "
+                        f"grad norm: {float(metrics['grad_norm']):.3f} | "
+                        f"skipped: {int(metrics['skipped'])} | "
+                        f"tokens/sec: {tps:,.0f} | "
+                        f"model TFLOP/s: {mfu_flops / 1e12:.1f}")
+                    window_tokens, window_t0 = 0, time.time()
+                    loss_avg, loss_n = 0.0, 0
+
+                if (valid_iter_factory and t.eval_interval
+                        and self.iteration % t.eval_interval == 0):
+                    ev = self.evaluate(valid_iter_factory(), t.eval_iters)
+                    self.log(f"validation | lm loss: {ev['lm_loss']:.6f} | "
+                             f"ppl: {ev['ppl']:.3f}")
+
+                should_exit = False
+                if sig.signals_received():
+                    self.log("received SIGTERM, checkpointing and exiting")
+                    should_exit = True
+                if t.exit_interval and self.iteration % t.exit_interval == 0:
+                    should_exit = True
+                if t.exit_duration_in_mins and (
+                        (time.time() - start_time) / 60 > t.exit_duration_in_mins):
+                    should_exit = True
+
+                saved_now = bool(
+                    t.save_interval and self.iteration % t.save_interval == 0)
+                if saved_now or should_exit:
+                    self.save()
+                if should_exit:
+                    return self.state
+                last_saved = self.iteration if saved_now else None
+
+        if self.cfg.training.save and last_saved != self.iteration:
+            self.save()
+        return self.state
+
+
+def pretrain(
+    run_cfg: RunConfig,
+    train_iter_factory,
+    valid_iter_factory=None,
+    log: Callable[[str], None] = print,
+) -> TrainState:
+    """One-call entry (ref: megatron/training.py pretrain())."""
+    loop = TrainLoop(run_cfg, log=log)
+    n_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(loop.state.params))
+    log(f"mesh: {dict(loop.rt.mesh.shape)} | params: {n_params:,}")
+    return loop.train(train_iter_factory, valid_iter_factory)

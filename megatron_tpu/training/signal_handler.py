@@ -1,0 +1,39 @@
+"""Graceful-exit signal handling.
+
+Equivalent of megatron/dist_signal_handler.py (81 LoC): install a SIGTERM
+handler that records the signal; the train loop polls it and
+checkpoints-then-exits. The reference all-gathers the flag over NCCL so
+every rank agrees; in a single-controller JAX program the controller *is*
+the agreement point, so the handler is just a flag.
+"""
+
+from __future__ import annotations
+
+import signal
+from types import FrameType
+from typing import Optional
+
+
+class DistributedSignalHandler:
+    def __init__(self, sig: int = signal.SIGTERM):
+        self.sig = sig
+        self._received = False
+        self._prev = None
+
+    def signals_received(self) -> bool:
+        return self._received
+
+    def __enter__(self) -> "DistributedSignalHandler":
+        self._received = False
+
+        def handler(signum: int, frame: Optional[FrameType]):
+            self._received = True
+
+        self._prev = signal.getsignal(self.sig)
+        signal.signal(self.sig, handler)
+        return self
+
+    def __exit__(self, *exc):
+        if self._prev is not None:
+            signal.signal(self.sig, self._prev)
+        return False

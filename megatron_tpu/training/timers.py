@@ -1,0 +1,84 @@
+"""Named span timers.
+
+Equivalent of megatron/timers.py (304 LoC): hierarchical named timers with
+a log level gate and elapsed reporting. CUDA-sync start/stop becomes a host
+sync via jax.block_until_ready on demand (on the axon plugin that call can
+no-op, so callers that need exact spans sync via host transfer). The deep
+profiling story is jax.profiler traces (start_trace/stop_trace), which the
+train loop exposes via TrainingConfig.tensorboard_dir.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+
+class _Timer:
+    def __init__(self, name: str):
+        self.name = name
+        self._start: Optional[float] = None
+        self._elapsed = 0.0
+        self._count = 0
+
+    def start(self):
+        if self._start is not None:
+            raise RuntimeError(f"timer {self.name} already started")
+        self._start = time.perf_counter()
+
+    def stop(self):
+        if self._start is None:
+            raise RuntimeError(f"timer {self.name} not started")
+        self._elapsed += time.perf_counter() - self._start
+        self._count += 1
+        self._start = None
+
+    def elapsed(self, reset: bool = True) -> float:
+        running = self._start is not None
+        if running:
+            self.stop()
+        out = self._elapsed
+        if reset:
+            self._elapsed = 0.0
+            self._count = 0
+        if running:
+            self.start()
+        return out
+
+
+class _DummyTimer:
+    def start(self):
+        pass
+
+    def stop(self):
+        pass
+
+    def elapsed(self, reset: bool = True) -> float:
+        return 0.0
+
+
+class Timers:
+    """timers('span', level)(start/stop); below-threshold spans are no-ops
+    (ref: Timers with --timing_log_level)."""
+
+    def __init__(self, log_level: int = 0):
+        self.log_level = log_level
+        self._timers: Dict[str, _Timer] = {}
+        self._dummy = _DummyTimer()
+
+    def __call__(self, name: str, level: int = 0):
+        if level > self.log_level:
+            return self._dummy
+        if name not in self._timers:
+            self._timers[name] = _Timer(name)
+        return self._timers[name]
+
+    def log_string(self, names=None, normalizer: float = 1.0,
+                   reset: bool = True) -> str:
+        names = names if names is not None else sorted(self._timers)
+        parts = []
+        for n in names:
+            if n in self._timers:
+                ms = self._timers[n].elapsed(reset) * 1000.0 / normalizer
+                parts.append(f"{n}: {ms:.2f}")
+        return "time (ms) | " + " | ".join(parts) if parts else ""

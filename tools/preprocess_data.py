@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""JSONL -> indexed dataset preprocessing.
+
+Equivalent of the reference's tools/preprocess_data.py (201 LoC): reads
+jsonl, tokenizes a chosen key per document with worker processes, appends
+EOD, writes .bin/.idx. The output is byte-compatible with the reference's
+datasets (same mmap format, same uint16 auto-dtype rule).
+
+Usage:
+  python tools/preprocess_data.py --input data.jsonl --output_prefix out \
+      --tokenizer_type SentencePieceTokenizer --tokenizer_model tok.model \
+      [--json_keys text] [--append_eod] [--workers 8]
+"""
+
+import argparse
+import json
+import multiprocessing
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from megatron_tpu.data.indexed_dataset import make_builder
+from megatron_tpu.tokenizer import build_tokenizer
+
+_worker_tokenizer = None
+_worker_args = None
+
+
+def _init_worker(args):
+    global _worker_tokenizer, _worker_args
+    _worker_args = args
+    _worker_tokenizer = build_tokenizer(
+        args.tokenizer_type,
+        vocab_file=args.vocab_file,
+        merges_file=args.merges_file,
+        tokenizer_model=args.tokenizer_model,
+        name_or_path=args.tokenizer_name_or_path,
+        vocab_size=args.vocab_size,
+    )
+
+
+def _encode(line):
+    line = line.strip()
+    if not line:
+        return None
+    doc = json.loads(line)
+    out = {}
+    for key in _worker_args.json_keys:
+        ids = _worker_tokenizer.tokenize(doc[key])
+        if _worker_args.append_eod:
+            ids = list(ids) + [_worker_tokenizer.eod]
+        out[key] = ids
+    return out
+
+
+def get_args(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--input", required=True, help="input jsonl file")
+    p.add_argument("--output_prefix", required=True)
+    p.add_argument("--json_keys", nargs="+", default=["text"])
+    p.add_argument("--append_eod", action="store_true")
+    p.add_argument("--tokenizer_type", default="SentencePieceTokenizer")
+    p.add_argument("--vocab_file", default=None)
+    p.add_argument("--merges_file", default=None)
+    p.add_argument("--tokenizer_model", default=None)
+    p.add_argument("--tokenizer_name_or_path", default=None)
+    p.add_argument("--vocab_size", type=int, default=None)
+    p.add_argument("--workers", type=int, default=1)
+    p.add_argument("--log_interval", type=int, default=10000)
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = get_args(argv)
+    _init_worker(args)
+    vocab_size = _worker_tokenizer.vocab_size
+
+    builders = {}
+    for key in args.json_keys:
+        suffix = f"_{key}" if len(args.json_keys) > 1 else ""
+        prefix = args.output_prefix + suffix
+        builders[key] = (prefix, make_builder(prefix, vocab_size=vocab_size))
+
+    t0 = time.time()
+    n = 0
+    with open(args.input, encoding="utf-8") as f:
+        if args.workers > 1:
+            pool = multiprocessing.Pool(args.workers, initializer=_init_worker,
+                                        initargs=(args,))
+            encoded = pool.imap(_encode, f, chunksize=32)
+        else:
+            encoded = map(_encode, f)
+        for doc in encoded:
+            if doc is None:
+                continue
+            for key, ids in doc.items():
+                builders[key][1].add_doc(ids)
+            n += 1
+            if n % args.log_interval == 0:
+                rate = n / (time.time() - t0)
+                print(f"processed {n} documents ({rate:.0f} docs/s)",
+                      file=sys.stderr)
+
+    for key, (prefix, builder) in builders.items():
+        builder.finalize(prefix + ".idx")
+        print(f"wrote {prefix}.bin/.idx ({n} documents)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Chat JSONL -> paired text/role indexed datasets for instruction tuning.
+
+Equivalent of tools/preprocess_instruct_data.py (196 LoC) in the reference:
+each input line holds a conversation; turns are tokenized and concatenated,
+and a parallel stream records each token's role (system/prompter/assistant)
+so the collator can weight assistant tokens in the loss.
+
+Input format (one json per line):
+  {"conversation": [{"role": "system"|"prompter"|"assistant", "text": "..."}]}
+Role aliases "user"->prompter and "gpt"/"bot"->assistant are accepted.
+
+Output: <output_prefix>-text.bin/.idx and <output_prefix>-role.bin/.idx.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from megatron_tpu.data.indexed_dataset import make_builder
+from megatron_tpu.data.instruction_dataset import ROLES
+from megatron_tpu.tokenizer import build_tokenizer
+
+_ALIASES = {"user": "prompter", "human": "prompter", "gpt": "assistant",
+            "bot": "assistant", "model": "assistant"}
+
+
+def get_args(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--input", required=True)
+    p.add_argument("--output_prefix", required=True)
+    p.add_argument("--tokenizer_type", default="SentencePieceTokenizer")
+    p.add_argument("--vocab_file", default=None)
+    p.add_argument("--merges_file", default=None)
+    p.add_argument("--tokenizer_model", default=None)
+    p.add_argument("--tokenizer_name_or_path", default=None)
+    p.add_argument("--vocab_size", type=int, default=None)
+    p.add_argument("--conversation_key", default="conversation")
+    p.add_argument("--append_eod", action="store_true")
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = get_args(argv)
+    tok = build_tokenizer(
+        args.tokenizer_type,
+        vocab_file=args.vocab_file,
+        merges_file=args.merges_file,
+        tokenizer_model=args.tokenizer_model,
+        name_or_path=args.tokenizer_name_or_path,
+        vocab_size=args.vocab_size,
+    )
+    text_prefix = args.output_prefix + "-text"
+    role_prefix = args.output_prefix + "-role"
+    text_builder = make_builder(text_prefix, vocab_size=tok.vocab_size)
+    role_builder = make_builder(role_prefix, vocab_size=tok.vocab_size)
+
+    n = 0
+    with open(args.input, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            convo = json.loads(line)[args.conversation_key]
+            tokens, roles = [], []
+            for turn in convo:
+                role_name = _ALIASES.get(turn["role"], turn["role"])
+                if role_name not in ROLES:
+                    raise ValueError(f"unknown role {turn['role']!r}")
+                ids = tok.tokenize(turn["text"])
+                tokens.extend(ids)
+                roles.extend([ROLES[role_name]] * len(ids))
+            if args.append_eod:
+                tokens.append(tok.eod)
+                roles.append(ROLES["assistant"])
+            text_builder.add_doc(tokens)
+            role_builder.add_doc(roles)
+            n += 1
+
+    text_builder.finalize(text_prefix + ".idx")
+    role_builder.finalize(role_prefix + ".idx")
+    print(f"wrote {n} conversations to {text_prefix}* and {role_prefix}*")
+
+
+if __name__ == "__main__":
+    main()
