@@ -261,6 +261,7 @@ def args_to_run_config(args) -> RunConfig:
         timing_log_level=args.timing_log_level,
         scalar_loss_mask=args.scalar_loss_mask,
         variable_seq_lengths=args.variable_seq_lengths,
+        metrics=tuple(args.metrics),
     ).validate()
 
     return RunConfig(model=model, parallel=parallel, optimizer=optimizer,

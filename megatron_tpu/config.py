@@ -26,12 +26,11 @@ import jax.numpy as jnp
 POSITION_EMBEDDING_TYPES = ("rotary", "absolute")
 NORMALIZATION_TYPES = ("layernorm", "rmsnorm")
 # GLU family per ref megatron/model/glu_activations.py plus plain variants.
-ACTIVATION_TYPES = ("gelu", "geglu", "swiglu", "reglu", "liglu", "relu", "squared_relu")
+ACTIVATION_TYPES = ("gelu", "gelu_tanh", "geglu", "swiglu", "reglu", "liglu", "relu", "squared_relu")
 GLU_ACTIVATIONS = ("geglu", "swiglu", "reglu", "liglu")
-# "padding" joins this list when encoder models (BERT/T5) land; until the
-# padding-mask plumbing exists end-to-end it is rejected rather than
-# silently training with future-token leakage.
-ATTN_MASK_TYPES = ("causal", "bidirectional")
+# "padding": bidirectional with a per-row key padding mask (BERT-style
+# encoders); requires an attention_mask input end-to-end.
+ATTN_MASK_TYPES = ("causal", "bidirectional", "padding")
 ATTENTION_IMPLS = ("xla", "pallas", "ring")
 RECOMPUTE_POLICIES = ("none", "selective", "full")
 DTYPES = {"bfloat16": jnp.bfloat16, "float16": jnp.float16, "float32": jnp.float32}
@@ -122,6 +121,12 @@ class ModelConfig:
     # (falls back to xla for unsupported shapes), or "ring" context-parallel
     # ring attention (requires an ambient mesh with a "context" axis).
     attention_impl: str = "xla"
+
+    # BERT-style extras (ref: megatron/model/bert_model.py,
+    # language_model.py Embedding tokentype path)
+    num_tokentypes: int = 0
+    # adds pooler + binary (NSP/SOP) head + MLM transform head params
+    bert_binary_head: bool = False
 
     # ----- derived helpers -------------------------------------------------
 
@@ -342,6 +347,8 @@ class TrainingConfig:
     # loss averaging for instruction tuning (ref finetune.py scalar_loss_mask)
     scalar_loss_mask: float = 0.0
     variable_seq_lengths: bool = False
+    # validation metrics registry names (ref: --metrics, megatron/metrics.py)
+    metrics: Tuple[str, ...] = ()
 
     def num_microbatches(self, global_batch: Optional[int], data_parallel: int) -> int:
         gbs = global_batch or self.global_batch_size

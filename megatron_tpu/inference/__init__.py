@@ -1,0 +1,21 @@
+from megatron_tpu.inference.sampling import sample_logits
+from megatron_tpu.inference.generation import (
+    GenerationOutput,
+    generate_tokens,
+    score_tokens,
+    beam_search_tokens,
+)
+from megatron_tpu.inference.api import (
+    generate_and_post_process,
+    beam_search_and_post_process,
+)
+
+__all__ = [
+    "sample_logits",
+    "GenerationOutput",
+    "generate_tokens",
+    "score_tokens",
+    "beam_search_tokens",
+    "generate_and_post_process",
+    "beam_search_and_post_process",
+]

@@ -1,0 +1,229 @@
+"""Autoregressive generation with functional KV caches.
+
+Equivalent of megatron/text_generation/generation.py (429 LoC) +
+forward_step.py (204): the reference's InferenceParams KV-cache dict and
+token-at-a-time pipeline become a jitted lax.while_loop whose carry holds
+the stacked per-layer caches; prompts of different lengths are handled the
+reference's way — decode starts at the shortest prompt and forced prompt
+tokens override samples until each row's prompt is exhausted
+(generation.py:89-287 generate_tokens_probs_and_return_on_first_stage).
+
+Early termination on EOD ends the while_loop when every row is done, so
+short generations don't pay for max_new_tokens steps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megatron_tpu.config import ModelConfig
+from megatron_tpu.inference.sampling import sample_logits
+from megatron_tpu.models.language_model import lm_forward
+
+
+@dataclasses.dataclass
+class GenerationOutput:
+    tokens: np.ndarray       # [B, total_len] int32 (prompt + generated)
+    lengths: np.ndarray      # [B] generated sequence end (index past last)
+    logprobs: np.ndarray     # [B, total_len-1] logprob of each emitted token
+
+
+def _init_caches(cfg: ModelConfig, batch: int, total_len: int):
+    shape = (cfg.num_layers, batch, total_len, cfg.n_kv_heads, cfg.head_dim)
+    return (jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
+
+
+@partial(jax.jit, static_argnames=("cfg", "total_len", "temperature", "top_k",
+                                   "top_p", "vocab_size", "eod"))
+def _generate_jit(
+    cfg: ModelConfig,
+    params: Any,
+    tokens: jnp.ndarray,    # [B, total_len], prompt tokens then pad
+    lengths: jnp.ndarray,   # [B] prompt lengths
+    key: jax.Array,
+    total_len: int,
+    temperature: float,
+    top_k: int,
+    top_p: float,
+    vocab_size: Optional[int],
+    eod: Optional[int],
+):
+    B = tokens.shape[0]
+    min_len = jnp.min(lengths)
+    caches = _init_caches(cfg, B, total_len)
+
+    # prefill [0, min_len) in one pass — the reference likewise batches the
+    # common prompt prefix
+    positions = jnp.arange(total_len)[None, :]
+    # pad the prefill to a static length (min_len is dynamic): run the full
+    # prompt region once with cache_index=0 and pick logits at min_len-1.
+    # Static shapes beat a dynamic-length prefill on TPU.
+    prefill_len = total_len - 1
+    logits_all, caches = lm_forward(
+        cfg, params, tokens[:, :prefill_len],
+        positions=positions[:, :prefill_len],
+        kv_caches=caches, cache_index=0)
+
+    logprobs_all = jax.nn.log_softmax(logits_all.astype(jnp.float32), axis=-1)
+
+    # carry: (t, tokens, caches, done, key, logprobs, last_logits)
+    def body2(carry):
+        t, tokens, caches, done, key, lp, last_logits = carry
+        key, sub = jax.random.split(key)
+        prev_logits = last_logits[:, 0]
+        sampled = sample_logits(prev_logits, sub, temperature, top_k, top_p,
+                                vocab_size)
+        in_prompt = t < lengths
+        forced = tokens[:, t]
+        nxt = jnp.where(in_prompt | done, forced, sampled)
+        if eod is not None:
+            nxt = jnp.where(done, eod, nxt)
+        tokens = tokens.at[:, t].set(nxt)
+        step_lp = jnp.take_along_axis(
+            jax.nn.log_softmax(prev_logits.astype(jnp.float32), axis=-1),
+            nxt[:, None], axis=-1)[:, 0]
+        lp = lp.at[:, t - 1].set(jnp.where(done, 0.0, step_lp))
+        if eod is not None:
+            done = done | ((nxt == eod) & ~in_prompt)
+        step_pos = jax.lax.dynamic_slice_in_dim(positions, t, 1, axis=1)
+        logits_step, caches = lm_forward(
+            cfg, params, nxt[:, None], positions=step_pos,
+            kv_caches=caches, cache_index=t)
+        return (t + 1, tokens, caches, done, key, lp, logits_step)
+
+    def cond2(carry):
+        t, tokens, caches, done, key, lp, last = carry
+        return (t < total_len) & ~jnp.all(done)
+
+    # seed the loop at t = min_len with the prefill logits at min_len-1
+    gather_idx = jnp.maximum(min_len - 1, 0)
+    first_logits = jnp.take_along_axis(
+        logits_all, jnp.full((B, 1, 1), gather_idx), axis=1)
+
+    # teacher-forced logprobs for the prompt region
+    lp0 = jnp.zeros((B, total_len - 1), jnp.float32)
+    prompt_lp = jnp.take_along_axis(
+        logprobs_all, tokens[:, 1:prefill_len + 1][..., None], axis=-1)[..., 0]
+    valid = (jnp.arange(1, prefill_len + 1)[None, :] < lengths[:, None])
+    lp0 = lp0.at[:, :prefill_len].set(jnp.where(valid, prompt_lp, 0.0))
+
+    done0 = jnp.zeros((B,), bool)
+    carry = (min_len, tokens, caches, done0, key, lp0, first_logits)
+    t, tokens, caches, done, key, lp, _ = jax.lax.while_loop(cond2, body2, carry)
+
+    if eod is not None:
+        has_eod = jnp.any(
+            (tokens == eod)
+            & (jnp.arange(total_len)[None, :] >= lengths[:, None]), axis=1)
+        first_eod = jnp.argmax(
+            (tokens == eod)
+            & (jnp.arange(total_len)[None, :] >= lengths[:, None]), axis=1)
+        ends = jnp.where(has_eod, first_eod + 1, total_len)
+    else:
+        ends = jnp.full((B,), total_len)
+    return tokens, ends, lp
+
+
+def generate_tokens(
+    cfg: ModelConfig,
+    params: Any,
+    prompts: np.ndarray,     # [B, max_prompt_len] int32, right-padded
+    lengths: np.ndarray,     # [B]
+    max_new_tokens: int,
+    temperature: float = 1.0,
+    top_k: int = 0,
+    top_p: float = 0.0,
+    vocab_size: Optional[int] = None,
+    eod: Optional[int] = None,
+    seed: int = 0,
+) -> GenerationOutput:
+    B, max_prompt = prompts.shape
+    total_len = max_prompt + max_new_tokens
+    tokens = np.zeros((B, total_len), np.int32)
+    tokens[:, :max_prompt] = prompts
+    toks, ends, lp = _generate_jit(
+        cfg, params, jnp.asarray(tokens), jnp.asarray(lengths, jnp.int32),
+        jax.random.PRNGKey(seed), total_len, float(temperature), int(top_k),
+        float(top_p), vocab_size, eod)
+    return GenerationOutput(tokens=np.asarray(toks), lengths=np.asarray(ends),
+                            logprobs=np.asarray(lp))
+
+
+def score_tokens(cfg: ModelConfig, params: Any, tokens: np.ndarray) -> np.ndarray:
+    """Teacher-forced per-token logprobs [B, S-1]
+    (ref: score_and_return_on_first_stage)."""
+    t = jnp.asarray(tokens, jnp.int32)
+    logits = lm_forward(cfg, params, t[:, :-1])
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    out = jnp.take_along_axis(lp, t[:, 1:][..., None], axis=-1)[..., 0]
+    return np.asarray(out)
+
+
+def beam_search_tokens(
+    cfg: ModelConfig,
+    params: Any,
+    prompt: np.ndarray,       # [prompt_len] single prompt (ref: batch=1 only)
+    max_new_tokens: int,
+    beam_size: int,
+    eod: int,
+    length_penalty: float = 1.0,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Beam search for one prompt (the reference's beam path also requires
+    batch 1, text_generation/api.py:147). Host-side loop over a jitted
+    scoring step; returns (beams [beam_size, total], scores [beam_size])."""
+    prompt = np.asarray(prompt, np.int32)
+    plen = len(prompt)
+    total = plen + max_new_tokens
+
+    @partial(jax.jit, static_argnames=())
+    def step_logits(toks):
+        return lm_forward(cfg, params, toks)[:, -1]  # [beams, V]
+
+    beams = np.tile(prompt[None, :], (beam_size, 1))
+    scores = np.full((beam_size,), -1e9, np.float64)
+    scores[0] = 0.0
+    finished = []  # (score_with_penalty, tokens) — BeamHypotheses equivalent
+
+    for t in range(plen, total):
+        logits = np.asarray(step_logits(jnp.asarray(beams)), np.float64)
+        logprobs = logits - np.log(np.exp(logits - logits.max(-1, keepdims=True))
+                                   .sum(-1, keepdims=True)) - logits.max(-1, keepdims=True)
+        cand = scores[:, None] + logprobs  # [beams, V]
+        flat = cand.reshape(-1)
+        top = np.argpartition(-flat, 2 * beam_size)[: 2 * beam_size]
+        top = top[np.argsort(-flat[top])]
+        new_beams, new_scores = [], []
+        for idx in top:
+            b, v = divmod(int(idx), logits.shape[-1])
+            seq = np.concatenate([beams[b], [v]])
+            if v == eod:
+                penalty = ((len(seq) - plen) ** length_penalty)
+                finished.append((flat[idx] / penalty, seq))
+            else:
+                new_beams.append(seq)
+                new_scores.append(flat[idx])
+            if len(new_beams) == beam_size:
+                break
+        beams = np.stack([np.pad(s, (0, total - len(s))) for s in new_beams])[:, :t + 1]
+        scores = np.asarray(new_scores)
+        if len(finished) >= beam_size:
+            best_possible = scores.max() / (max(1, t + 1 - plen) ** length_penalty)
+            worst_kept = sorted(finished, key=lambda x: -x[0])[beam_size - 1][0]
+            if worst_kept >= best_possible:
+                break
+
+    for s, b in zip(scores, beams):
+        penalty = (max(1, beams.shape[1] - plen) ** length_penalty)
+        finished.append((s / penalty, np.concatenate([b, [eod]])))
+    finished.sort(key=lambda x: -x[0])
+    finished = finished[:beam_size]
+    out_tokens = np.stack([np.pad(f[1], (0, total + 1 - len(f[1])),
+                                  constant_values=eod) for f in finished])
+    out_scores = np.asarray([f[0] for f in finished])
+    return out_tokens, out_scores

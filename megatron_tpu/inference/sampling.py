@@ -1,0 +1,55 @@
+"""Token sampling: greedy / temperature / top-k / top-p.
+
+Equivalent of megatron/text_generation/sampling.py (93 LoC), as one jittable
+function. Filtering works on sorted logits so top-k and top-p compose, and
+everything stays fixed-shape for XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_logits(
+    logits: jnp.ndarray,          # [B, V] float
+    key: Optional[jax.Array],
+    temperature: float = 1.0,
+    top_k: int = 0,
+    top_p: float = 0.0,
+    vocab_size: Optional[int] = None,
+) -> jnp.ndarray:
+    """Returns sampled token ids [B]. top_k=0/top_p=0 disable the filters;
+    temperature 0 (or key None) is greedy (ref: sampling.py sample())."""
+    logits = logits.astype(jnp.float32)
+    if vocab_size is not None and vocab_size < logits.shape[-1]:
+        # clamp padded vocab columns (ref: vocab boundary clamp)
+        neg = jnp.finfo(jnp.float32).min
+        mask = jnp.arange(logits.shape[-1]) < vocab_size
+        logits = jnp.where(mask, logits, neg)
+
+    greedy = key is None or temperature == 0.0
+    if greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    logits = logits / temperature
+
+    if top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, jnp.finfo(jnp.float32).min, logits)
+
+    if top_p > 0.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with cumulative prob >= top_p (always
+        # keep the top token)
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1)  # [B]
+        cutoff_logit = jnp.take_along_axis(
+            sorted_logits, cutoff_idx[:, None], axis=-1)
+        logits = jnp.where(logits < cutoff_logit,
+                           jnp.finfo(jnp.float32).min, logits)
+
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)

@@ -1,0 +1,110 @@
+"""REST text-generation server.
+
+Equivalent of megatron/text_generation_server.py (241 LoC,
+Flask + flask_restful) on the stdlib http.server — PUT/POST /api with the
+same request schema:
+
+  {"prompts": [...], "tokens_to_generate": N, "temperature": T,
+   "top_k": K, "top_p": P, "add_BOS": bool, "logprobs": bool,
+   "random_seed": S, "beam_width": W?}
+
+beam_width switches to beam search (the reference's separate BEAM choice
+int broadcast becomes just a field — no multi-rank choreography). A global
+lock serializes requests like the reference's Flask lock.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+from megatron_tpu.config import ModelConfig
+from megatron_tpu.inference.api import (
+    beam_search_and_post_process, generate_and_post_process,
+)
+
+MAX_TOKENS_TO_GENERATE = 1024  # ref caps requests similarly
+MAX_PROMPTS = 128
+
+
+class GenerationService:
+    def __init__(self, cfg: ModelConfig, params: Any, tokenizer):
+        self.cfg = cfg
+        self.params = params
+        self.tokenizer = tokenizer
+        self.lock = threading.Lock()
+
+    def handle(self, req: dict) -> dict:
+        prompts = req.get("prompts")
+        if not isinstance(prompts, list) or not prompts:
+            raise ValueError("prompts: non-empty list of strings required")
+        if len(prompts) > MAX_PROMPTS:
+            raise ValueError(f"at most {MAX_PROMPTS} prompts per request")
+        if not all(isinstance(p, str) and p for p in prompts):
+            raise ValueError("prompts must be non-empty strings")
+        n = int(req.get("tokens_to_generate", 64))
+        if not 0 <= n <= MAX_TOKENS_TO_GENERATE:
+            raise ValueError(f"tokens_to_generate in [0, {MAX_TOKENS_TO_GENERATE}]")
+
+        with self.lock:
+            if req.get("beam_width"):
+                texts, segments, scores = beam_search_and_post_process(
+                    self.cfg, self.params, self.tokenizer, prompts,
+                    tokens_to_generate=n,
+                    beam_size=int(req["beam_width"]),
+                    add_BOS=bool(req.get("add_BOS", False)),
+                    length_penalty=float(req.get("length_penalty", 1.0)))
+                return {"text": texts, "segments": segments,
+                        "scores": [float(s) for s in scores]}
+            texts, segments, logprobs, _ = generate_and_post_process(
+                self.cfg, self.params, self.tokenizer, prompts,
+                tokens_to_generate=n,
+                temperature=float(req.get("temperature", 1.0)),
+                top_k_sampling=int(req.get("top_k", 0)),
+                top_p_sampling=float(req.get("top_p", 0.0)),
+                add_BOS=bool(req.get("add_BOS", False)),
+                return_output_log_probs=bool(req.get("logprobs", False)),
+                random_seed=int(req.get("random_seed", 0)))
+            out = {"text": texts, "segments": segments}
+            if logprobs is not None:
+                out["logprobs"] = [list(map(float, row)) for row in logprobs]
+            return out
+
+
+def make_handler(service: GenerationService):
+    class Handler(BaseHTTPRequestHandler):
+        def _reply(self, code: int, payload: dict):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _handle(self):
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(length) or b"{}")
+                self._reply(200, service.handle(req))
+            except ValueError as e:
+                self._reply(400, {"message": str(e)})
+            except Exception as e:  # noqa: BLE001 — server must not die
+                self._reply(500, {"message": f"internal error: {e}"})
+
+        do_PUT = _handle
+        do_POST = _handle
+
+        def log_message(self, *a):  # quiet
+            pass
+
+    return Handler
+
+
+def run_server(cfg: ModelConfig, params: Any, tokenizer,
+               host: str = "0.0.0.0", port: int = 5000) -> None:
+    service = GenerationService(cfg, params, tokenizer)
+    server = ThreadingHTTPServer((host, port), make_handler(service))
+    print(f"serving generation API on http://{host}:{port}/api")
+    server.serve_forever()

@@ -105,12 +105,15 @@ def config_from_hf(hf_config, seq_length: int = None) -> ModelConfig:
             num_layers=hf_config.n_layer,
             hidden_size=hf_config.n_embd,
             num_attention_heads=hf_config.n_head,
-            ffn_hidden_size=4 * hf_config.n_embd,
+            ffn_hidden_size=getattr(hf_config, "n_inner", None)
+            or 4 * hf_config.n_embd,
             vocab_size=hf_config.vocab_size,
             seq_length=seq_length or hf_config.n_positions,
             max_position_embeddings=hf_config.n_positions,
             normalization="layernorm",
-            activation="gelu",
+            activation=("gelu_tanh"
+                        if getattr(hf_config, "activation_function",
+                                   "gelu_new") == "gelu_new" else "gelu"),
             position_embedding_type="absolute",
             use_bias_linear=True,
             use_bias_qkv=True,
@@ -118,6 +121,65 @@ def config_from_hf(hf_config, seq_length: int = None) -> ModelConfig:
             layernorm_epsilon=hf_config.layer_norm_epsilon,
         ).validate()
     raise ValueError(f"unsupported HF model_type {mt!r}")
+
+
+def hf_config_from_native(cfg: ModelConfig, model_type: str):
+    """Inverse of config_from_hf — build a transformers config so converted
+    weights can be loaded/saved with HF tooling
+    (ref: megatron_to_hf.py writes config.json per arch)."""
+    if model_type in ("llama", "mistral"):
+        common = dict(
+            vocab_size=cfg.vocab_size,
+            hidden_size=cfg.hidden_size,
+            intermediate_size=cfg.ffn_size,
+            num_hidden_layers=cfg.num_layers,
+            num_attention_heads=cfg.num_attention_heads,
+            num_key_value_heads=cfg.n_kv_heads,
+            max_position_embeddings=cfg.seq_length,
+            rms_norm_eps=cfg.layernorm_epsilon,
+            rope_theta=cfg.rope_theta,
+            tie_word_embeddings=cfg.tie_embed_logits,
+        )
+        if model_type == "llama":
+            from transformers import LlamaConfig
+
+            if cfg.rope_scaling_factor != 1.0:
+                common["rope_scaling"] = {"rope_type": "linear",
+                                          "factor": cfg.rope_scaling_factor}
+            return LlamaConfig(**common)
+        from transformers import MistralConfig
+
+        return MistralConfig(sliding_window=cfg.sliding_window_size, **common)
+    if model_type == "falcon":
+        from transformers import FalconConfig
+
+        new_arch = cfg.parallel_layernorm
+        return FalconConfig(
+            vocab_size=cfg.vocab_size,
+            hidden_size=cfg.hidden_size,
+            num_hidden_layers=cfg.num_layers,
+            num_attention_heads=cfg.num_attention_heads,
+            num_kv_heads=cfg.n_kv_heads,
+            layer_norm_epsilon=cfg.layernorm_epsilon,
+            bias=False, alibi=False, parallel_attn=cfg.parallel_attn,
+            new_decoder_architecture=new_arch,
+            multi_query=(cfg.n_kv_heads == 1 and not new_arch),
+        )
+    if model_type == "gpt2":
+        from transformers import GPT2Config
+
+        return GPT2Config(
+            vocab_size=cfg.vocab_size,
+            n_positions=cfg.max_position_embeddings,
+            n_embd=cfg.hidden_size,
+            n_layer=cfg.num_layers,
+            n_head=cfg.num_attention_heads,
+            n_inner=cfg.ffn_size,
+            activation_function=("gelu_new" if cfg.activation == "gelu_tanh"
+                                 else "gelu"),
+            layer_norm_epsilon=cfg.layernorm_epsilon,
+        )
+    raise ValueError(f"unsupported model_type {model_type!r}")
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,102 @@
+"""BERT: bidirectional encoder with MLM + binary (NSP) heads.
+
+Equivalent of megatron/model/bert_model.py (242 LoC): the encoder is the
+same unified block stack run with attn_mask_type="padding" (bidirectional +
+per-row key padding mask); heads follow the reference — BertLMHead
+(dense -> gelu -> layernorm -> tied decoder + bias) and the
+Pooler + binary head for next-sentence/sentence-order prediction.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from megatron_tpu.config import ModelConfig
+from megatron_tpu.models.language_model import lm_forward
+from megatron_tpu.models.transformer import Sharder, _identity_sharder
+from megatron_tpu.ops.cross_entropy import cross_entropy_loss
+from megatron_tpu.ops.normalization import layernorm
+
+
+def bert_config(
+    num_layers: int = 12,
+    hidden_size: int = 768,
+    num_attention_heads: int = 12,
+    vocab_size: int = 30592,   # 30522 padded
+    seq_length: int = 512,
+    **kw,
+) -> ModelConfig:
+    base = dict(
+        num_layers=num_layers, hidden_size=hidden_size,
+        num_attention_heads=num_attention_heads, vocab_size=vocab_size,
+        seq_length=seq_length, max_position_embeddings=seq_length,
+        position_embedding_type="absolute",
+        normalization="layernorm", activation="gelu",
+        use_bias_linear=True, use_bias_qkv=True,
+        tie_embed_logits=True, attn_mask_type="padding",
+        num_tokentypes=2, bert_binary_head=True,
+        hidden_dropout=0.1, attention_dropout=0.1,
+    )
+    base.update(kw)
+    return ModelConfig(**base).validate()
+
+
+def bert_forward(
+    cfg: ModelConfig,
+    params: Dict[str, Any],
+    tokens: jnp.ndarray,            # [B, S]
+    padding_mask: jnp.ndarray,      # [B, S] True = real token
+    tokentype_ids: Optional[jnp.ndarray] = None,
+    dropout_key: Optional[jax.Array] = None,
+    sharder: Sharder = _identity_sharder,
+) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+    """Returns (mlm_logits [B,S,V], binary_logits [B,2] or None)."""
+    hidden = lm_forward(
+        cfg, params, tokens,
+        dropout_key=dropout_key, sharder=sharder, return_hidden=True,
+        attention_mask=padding_mask, tokentype_ids=tokentype_ids)
+
+    # MLM head (ref: BertLMHead)
+    mh = params["mlm_head"]
+    h = jnp.einsum("bsh,hk->bsk", hidden, mh["dense_w"]) + mh["dense_b"]
+    h = jax.nn.gelu(h, approximate=False)
+    h = layernorm(h, mh["norm_scale"], mh["norm_bias"], cfg.layernorm_epsilon)
+    logits = jnp.einsum("bsh,vh->bsv", h, params["embed"]["tokens"]) + mh["bias"]
+
+    binary_logits = None
+    if cfg.bert_binary_head:
+        pooled = jnp.tanh(
+            jnp.einsum("bh,hk->bk", hidden[:, 0], params["pooler"]["w"])
+            + params["pooler"]["b"])
+        binary_logits = pooled @ params["binary_head"]["w"] + params["binary_head"]["b"]
+    return logits, binary_logits
+
+
+def bert_loss(
+    cfg: ModelConfig,
+    params: Dict[str, Any],
+    batch: Dict[str, jnp.ndarray],
+    dropout_key: Optional[jax.Array] = None,
+    sharder: Sharder = _identity_sharder,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """batch: tokens, padding_mask, tokentype_ids, labels (MLM targets),
+    loss_mask (1 at masked positions), is_random (binary target) —
+    ref: pretrain_bert.py forward_step + bert loss."""
+    logits, binary_logits = bert_forward(
+        cfg, params, batch["tokens"], batch["padding_mask"] > 0,
+        tokentype_ids=batch.get("tokentype_ids"),
+        dropout_key=dropout_key, sharder=sharder)
+    mlm_loss, _ = cross_entropy_loss(
+        logits, batch["labels"], loss_mask=batch["loss_mask"])
+    total = mlm_loss
+    aux = {"mlm_loss": mlm_loss}
+    if binary_logits is not None and "is_random" in batch:
+        sop, _ = cross_entropy_loss(
+            binary_logits[:, None, :], batch["is_random"][:, None])
+        total = total + sop
+        aux["sop_loss"] = sop
+    aux["loss"] = total
+    return total, aux

@@ -58,13 +58,16 @@ def embed_tokens(
     tokens: jnp.ndarray,                  # [B, S] int32
     positions: Optional[jnp.ndarray],
     dropout_key: Optional[jax.Array] = None,
+    tokentype_ids: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
-    """Token (+ absolute position) embedding with embedding dropout
-    (ref: language_model.py:133-262 Embedding)."""
+    """Token (+ absolute position, + tokentype) embedding with embedding
+    dropout (ref: language_model.py:133-262 Embedding)."""
     x = jnp.take(params["embed"]["tokens"], tokens, axis=0)
     if cfg.position_embedding_type == "absolute":
         pos = positions if positions is not None else jnp.arange(tokens.shape[1])[None, :]
         x = x + jnp.take(params["embed"]["pos"], pos, axis=0)
+    if tokentype_ids is not None:
+        x = x + jnp.take(params["embed"]["tokentype"], tokentype_ids, axis=0)
     if cfg.hidden_dropout > 0 and dropout_key is not None:
         x = _dropout(x, cfg.hidden_dropout, dropout_key)
     return x
@@ -90,6 +93,8 @@ def lm_forward(
     kv_caches: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,  # [L,B,Smax,nkv,D] x2
     cache_index=None,
     return_hidden: bool = False,
+    attention_mask: Optional[jnp.ndarray] = None,  # [B, S] True = attend
+    tokentype_ids: Optional[jnp.ndarray] = None,   # [B, S] (BERT segments)
 ):
     """Forward pass to logits.
 
@@ -105,6 +110,7 @@ def lm_forward(
     x = embed_tokens(
         cfg, params, tokens, positions,
         dropout_key=jax.random.fold_in(dropout_key, 0xE0B) if train else None,
+        tokentype_ids=tokentype_ids,
     )
     x = sharder(x, "residual")
 
@@ -130,6 +136,7 @@ def lm_forward(
             kv_cache=caches,
             cache_index=cache_index,
             sharder=sharder,
+            padding_mask=attention_mask,
         )
         return y, new_cache
 

@@ -50,6 +50,8 @@ def _defs(cfg: ModelConfig) -> Dict[str, Any]:
     d["embed/tokens"] = ((V, h), P(AXIS_TENSOR, None), _NORMAL)
     if cfg.position_embedding_type == "absolute":
         d["embed/pos"] = ((cfg.max_position_embeddings, h), P(None, None), _NORMAL)
+    if cfg.num_tokentypes > 0:
+        d["embed/tokentype"] = ((cfg.num_tokentypes, h), P(None, None), _NORMAL)
 
     ln_bias = cfg.normalization == "layernorm"
 
@@ -86,6 +88,18 @@ def _defs(cfg: ModelConfig) -> Dict[str, Any]:
         d["final_ln/bias"] = ((h,), P(None), _ZEROS)
     if not cfg.tie_embed_logits:
         d["lm_head/w"] = ((h, V), P(None, AXIS_TENSOR), _NORMAL)
+    if cfg.bert_binary_head:
+        # MLM transform (dense+gelu+LN) over tied decoder + output bias,
+        # pooler + binary head (ref: bert_model.py BertLMHead / Pooler)
+        d["mlm_head/dense_w"] = ((h, h), P(None, None), _NORMAL)
+        d["mlm_head/dense_b"] = ((h,), P(None), _ZEROS)
+        d["mlm_head/norm_scale"] = ((h,), P(None), _ONES)
+        d["mlm_head/norm_bias"] = ((h,), P(None), _ZEROS)
+        d["mlm_head/bias"] = ((V,), P(AXIS_TENSOR), _ZEROS)
+        d["pooler/w"] = ((h, h), P(None, None), _NORMAL)
+        d["pooler/b"] = ((h,), P(None), _ZEROS)
+        d["binary_head/w"] = ((h, 2), P(None, None), _NORMAL)
+        d["binary_head/b"] = ((2,), P(None), _ZEROS)
     return d
 
 

@@ -57,6 +57,7 @@ def attention_block(
     attn_dropout_key: Optional[jax.Array] = None,
     kv_cache: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
     cache_index=None,
+    padding_mask: Optional[jnp.ndarray] = None,  # [B, S] True = attend
 ) -> Tuple[jnp.ndarray, Optional[Tuple[jnp.ndarray, jnp.ndarray]]]:
     """Returns (out [B,S,h], updated kv_cache)."""
     b, s, _ = x.shape
@@ -86,9 +87,15 @@ def attention_block(
         kv_cache = (kc, vc)
         q_offset = cache_index
 
+    if cfg.attn_mask_type == "padding" and padding_mask is None:
+        raise ValueError(
+            "attn_mask_type='padding' requires an attention_mask input — "
+            "running without one would silently attend to pad tokens")
     ctx = attention(
         q, k, v,
-        mask_type=cfg.attn_mask_type,
+        mask_type=("bidirectional" if cfg.attn_mask_type == "padding"
+                   else cfg.attn_mask_type),
+        padding_mask=padding_mask,
         sliding_window=cfg.sliding_window_size,
         dropout=cfg.attention_dropout if attn_dropout_key is not None else 0.0,
         dropout_rng=attn_dropout_key,
@@ -124,6 +131,7 @@ def block_forward(
     kv_cache: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
     cache_index=None,
     sharder: Sharder = _identity_sharder,
+    padding_mask: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, Optional[Tuple[jnp.ndarray, jnp.ndarray]]]:
     """One decoder layer. hidden_dropout_rate may be a traced scalar (LIMA
     per-layer ramp, ref transformer.py:994-1001)."""
@@ -138,6 +146,7 @@ def block_forward(
         cfg, lp["attn"], normed, rope, positions,
         attn_dropout_key=k_attn_drop if cfg.attention_dropout > 0 else None,
         kv_cache=kv_cache, cache_index=cache_index,
+        padding_mask=padding_mask,
     )
     attn_out = _dropout(attn_out, rate, k_hidden1 if cfg.hidden_dropout > 0 else None)
 

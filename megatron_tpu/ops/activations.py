@@ -24,6 +24,8 @@ def _split_glu(x: jnp.ndarray):
 def apply_activation(name: str, x: jnp.ndarray) -> jnp.ndarray:
     if name == "gelu":
         return jax.nn.gelu(x, approximate=False)
+    if name == "gelu_tanh":  # HF "gelu_new" (tanh approximation)
+        return jax.nn.gelu(x, approximate=True)
     if name == "relu":
         return jax.nn.relu(x)
     if name == "squared_relu":

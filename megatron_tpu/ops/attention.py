@@ -104,7 +104,12 @@ def attention(
                     "is unavailable; falling back to the O(S^2) XLA path",
                     stacklevel=2)
             if flash_attention is not None:
-                return flash_attention(q, k, v, sliding_window=sliding_window)
+                try:
+                    return flash_attention(q, k, v, sliding_window=sliding_window)
+                except ValueError as e:
+                    warnings.warn(
+                        f"flash kernel unavailable for this config ({e}); "
+                        "falling back to the O(S^2) XLA path", stacklevel=2)
         # fall through to the XLA path for shapes/features the kernel
         # doesn't cover (decode steps, padding masks, dropout)
 

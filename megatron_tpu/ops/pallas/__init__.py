@@ -1,0 +1,8 @@
+"""Pallas TPU kernels for the hot ops.
+
+These are the TPU-native equivalents of the reference's CUDA kernel zoo
+(megatron/fused_kernels/: the three scaled-masked-softmax kernels, fused
+layernorm) and its FlashAttention-2 dependency (transformer.py:9,524-553).
+Everything else the CUDA kernels fuse by hand, XLA fuses on TPU; attention
+is the one op where a hand-written blockwise kernel beats the compiler.
+"""

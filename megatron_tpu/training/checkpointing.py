@@ -115,7 +115,35 @@ def load_checkpoint(
 
     ckptr = ocp.StandardCheckpointer()
     abstract = _abstract_like(state_template, shardings)
-    restored: TrainState = ckptr.restore(os.path.join(path, "state"), abstract)
+    try:
+        restored: TrainState = ckptr.restore(os.path.join(path, "state"), abstract)
+    except ValueError as e:
+        if "tree structures do not match" not in str(e) or state_template.master is not None:
+            raise
+        # the checkpoint was written by a mixed-precision run (fp32 master
+        # copies present) but this template has none (fp32 params, or an
+        # inference-only load) — restore with a synthesized master tree and
+        # drop it below
+        import jax.numpy as jnp
+
+        if shardings is not None:
+            fake_master = jax.tree.map(
+                lambda x, s: jax.ShapeDtypeStruct(x.shape, jnp.float32,
+                                                  sharding=s),
+                state_template.params, shardings.params)
+        else:
+            fake_master = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, jnp.float32),
+                state_template.params)
+        abstract = dataclasses.replace(abstract, master=fake_master)
+        restored = ckptr.restore(os.path.join(path, "state"), abstract)
+        # prefer the fp32 masters as the source of truth for params
+        restored = dataclasses.replace(
+            restored,
+            params=jax.tree.map(
+                lambda m, p: m.astype(p.dtype), restored.master,
+                state_template.params),
+            master=None)
 
     if finetune or no_load_optim:
         restored = dataclasses.replace(
@@ -129,6 +157,51 @@ def load_checkpoint(
             restored = dataclasses.replace(restored, step=state_template.step)
             return restored, 0, 0
     return restored, int(meta["iteration"]), int(meta["consumed_train_samples"])
+
+
+def load_params_only(
+    load: str,
+    params_template: Any,
+    iteration: Optional[int] = None,
+    shardings=None,
+) -> Any:
+    """Restore just the model params subtree (weights-only export/serving) —
+    avoids materializing optimizer moments for a read-only load.
+
+    Prefers the fp32 master copies when the checkpoint has them."""
+    it = iteration if iteration is not None else read_tracker(load)
+    if it is None:
+        raise FileNotFoundError(f"no checkpoint tracker in {load}")
+    path = os.path.join(checkpoint_dir(load, it), "state")
+
+    import jax
+    import jax.numpy as jnp
+
+    def abstract(tree, dtype=None, shards=None):
+        if shards is not None:
+            return jax.tree.map(
+                lambda x, s: jax.ShapeDtypeStruct(x.shape, dtype or x.dtype,
+                                                  sharding=s), tree, shards)
+        return jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, dtype or x.dtype), tree)
+
+    ckptr = ocp.PyTreeCheckpointer()
+    try:
+        # prefer the fp32 master copies when the checkpoint has them
+        target = {"master": abstract(params_template, dtype=jnp.float32,
+                                     shards=shardings)}
+        restored = ckptr.restore(
+            path, args=ocp.args.PyTreeRestore(
+                item=target, partial_restore=True))["master"]
+    except Exception:
+        target = {"params": abstract(params_template, shards=shardings)}
+        restored = ckptr.restore(
+            path, args=ocp.args.PyTreeRestore(
+                item=target, partial_restore=True))["params"]
+    # stored dtype may differ from the serving dtype (e.g. bf16 checkpoint
+    # served fp32, or master fp32 served bf16) — land on the template's
+    return jax.tree.map(lambda r, p: r.astype(p.dtype),
+                        restored, params_template)
 
 
 def check_config_compatibility(saved: Dict[str, Any], current: Dict[str, Any]):

@@ -1,0 +1,51 @@
+"""Scalar logging: tensorboard and/or wandb behind one add_scalar API.
+
+Equivalent of the reference's tensorboard wiring in training_log
+(training.py:462-641) and the WandbTBShim (megatron/wandb_logger.py, 174
+LoC — exposes add_scalar over wandb). Here one Writer multiplexes both;
+each backend is optional and failures to import degrade to console-only.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class Writer:
+    def __init__(self, tensorboard_dir: Optional[str] = None,
+                 wandb: bool = False, wandb_project: str = "megatron_tpu",
+                 wandb_name: Optional[str] = None, config: Optional[dict] = None):
+        self._tb = None
+        self._wandb = None
+        if tensorboard_dir:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._tb = SummaryWriter(log_dir=tensorboard_dir)
+            except Exception as e:  # tensorboard not installed
+                print(f"tensorboard unavailable ({e}); scalars not written")
+        if wandb:
+            try:
+                import wandb as wandb_mod
+
+                self._wandb = wandb_mod
+                wandb_mod.init(project=wandb_project, name=wandb_name,
+                               config=config or {})
+            except Exception as e:
+                print(f"wandb unavailable ({e}); scalars not written")
+
+    def add_scalar(self, tag: str, value: float, step: int) -> None:
+        if self._tb is not None:
+            self._tb.add_scalar(tag, value, step)
+        if self._wandb is not None:
+            self._wandb.log({tag: value}, step=step)
+
+    def flush(self) -> None:
+        if self._tb is not None:
+            self._tb.flush()
+
+    def close(self) -> None:
+        if self._tb is not None:
+            self._tb.close()
+        if self._wandb is not None:
+            self._wandb.finish()

@@ -1,0 +1,61 @@
+"""Pluggable validation metrics.
+
+Equivalent of megatron/metrics.py (110 LoC): a registry of named metrics
+computed on eval batches (ref: --metrics flag -> METRICS mapping, used by
+finetune.py loss_func on eval). All are jit-friendly functions of
+(logits, labels, loss_mask, per_token_loss).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax.numpy as jnp
+
+from megatron_tpu.ops.cross_entropy import vocab_argmax
+
+# instruction-tuning control-token roles are excluded from instruct
+# accuracy via the loss mask (assistant tokens weigh 1.0 there)
+
+
+def perplexity(logits, labels, loss_mask, per_token_loss):
+    mask = loss_mask.astype(jnp.float32)
+    mean = jnp.sum(per_token_loss * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.exp(jnp.minimum(mean, 20.0))
+
+
+def accuracy(logits, labels, loss_mask, per_token_loss):
+    pred = vocab_argmax(logits)
+    correct = (pred == labels).astype(jnp.float32)
+    mask = (loss_mask > 0).astype(jnp.float32)
+    return jnp.sum(correct * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def instruct_accuracy(logits, labels, loss_mask, per_token_loss):
+    """Accuracy over full-weight (assistant) tokens only
+    (ref: metrics.py instruct_accuracy masks chat-control tokens)."""
+    pred = vocab_argmax(logits)
+    correct = (pred == labels).astype(jnp.float32)
+    mask = (loss_mask >= 1.0).astype(jnp.float32)
+    return jnp.sum(correct * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def count_loss_mask(logits, labels, loss_mask, per_token_loss):
+    return jnp.sum((loss_mask > 0).astype(jnp.float32))
+
+
+METRICS: Dict[str, Callable] = {
+    "perplexity": perplexity,
+    "accuracy": accuracy,
+    "instruct_accuracy": instruct_accuracy,
+    "count_loss_mask": count_loss_mask,
+}
+
+
+def compute_metrics(names, logits, labels, loss_mask, per_token_loss):
+    out = {}
+    for name in names:
+        if name not in METRICS:
+            raise ValueError(f"unknown metric {name!r}; one of {sorted(METRICS)}")
+        out[name] = METRICS[name](logits, labels, loss_mask, per_token_loss)
+    return out

@@ -123,6 +123,13 @@ class TrainLoop:
         self._step_cache: Dict[int, Callable] = {}
         self.eval_step = None
 
+        from megatron_tpu.training.logging_writer import Writer
+
+        self.writer = Writer(
+            tensorboard_dir=run_cfg.training.tensorboard_dir,
+            wandb=run_cfg.training.wandb_logger,
+            config=run_cfg.to_dict())
+
     # -- checkpoint ---------------------------------------------------------
 
     def _load(self):
@@ -168,15 +175,23 @@ class TrainLoop:
                 train_iters=self.cfg.training.train_iters or 1,
                 sharder=self._sharder,
                 pipeline_loss_fn=pp_loss_fn)
+            # batch leaves were placed by _put_batch (rank-aware specs);
+            # let jit infer their shardings from the arguments
             self._step_cache[num_microbatches] = jax.jit(
                 step,
-                in_shardings=(self.state_shardings, self.batch_sharding),
+                in_shardings=(self.state_shardings, None),
                 donate_argnums=(0,))
         return self._step_cache[num_microbatches]
 
     def _put_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, jnp.ndarray]:
-        return {k: jax.device_put(v, self.batch_sharding)
-                for k, v in batch.items()}
+        def put(v):
+            if v.ndim == 1:  # per-sample scalars (e.g. BERT is_random)
+                sh = NamedSharding(self.rt.mesh, P("data"))
+            else:
+                sh = self.batch_sharding
+            return jax.device_put(v, sh)
+
+        return {k: put(np.asarray(v)) for k, v in batch.items()}
 
     def train_step(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
         gbs = batch["tokens"].shape[0]
@@ -195,6 +210,7 @@ class TrainLoop:
                                 sharder=self._sharder)
             self.eval_step = jax.jit(es)
         total, count = 0.0, 0
+        extras: Dict[str, float] = {}
         with jax.sharding.set_mesh(self.rt.mesh):
             for _ in range(eval_iters):
                 batch = next(data_iter, None)
@@ -202,9 +218,15 @@ class TrainLoop:
                     break
                 out = self.eval_step(self.state.params, self._put_batch(batch))
                 total += float(out["lm_loss"])
+                for k, v in out.items():
+                    if k not in ("lm_loss", "ntokens"):
+                        extras[k] = extras.get(k, 0.0) + float(v)
                 count += 1
         loss = total / max(count, 1)
-        return {"lm_loss": loss, "ppl": float(np.exp(min(loss, 20.0)))}
+        out = {"lm_loss": loss, "ppl": float(np.exp(min(loss, 20.0)))}
+        for m in extras:
+            out[m] = extras[m] / max(count, 1)
+        return out
 
     # -- loop ---------------------------------------------------------------
 
@@ -265,14 +287,31 @@ class TrainLoop:
                         f"skipped: {int(metrics['skipped'])} | "
                         f"tokens/sec: {tps:,.0f} | "
                         f"model TFLOP/s: {mfu_flops / 1e12:.1f}")
+                    self.writer.add_scalar("train/lm_loss",
+                                           loss_avg / max(loss_n, 1),
+                                           self.iteration)
+                    self.writer.add_scalar("train/lr", float(metrics["lr"]),
+                                           self.iteration)
+                    self.writer.add_scalar("train/grad_norm",
+                                           float(metrics["grad_norm"]),
+                                           self.iteration)
+                    self.writer.add_scalar("train/tokens_per_sec", tps,
+                                           self.iteration)
+                    self.writer.flush()
                     window_tokens, window_t0 = 0, time.time()
                     loss_avg, loss_n = 0.0, 0
 
                 if (valid_iter_factory and t.eval_interval
                         and self.iteration % t.eval_interval == 0):
                     ev = self.evaluate(valid_iter_factory(), t.eval_iters)
+                    extra = " | ".join(f"{k}: {v:.4f}" for k, v in ev.items()
+                                       if k not in ("lm_loss", "ppl"))
                     self.log(f"validation | lm loss: {ev['lm_loss']:.6f} | "
-                             f"ppl: {ev['ppl']:.3f}")
+                             f"ppl: {ev['ppl']:.3f}"
+                             + (f" | {extra}" if extra else ""))
+                    for k, v in ev.items():
+                        self.writer.add_scalar(f"valid/{k}", v, self.iteration)
+                    self.writer.flush()
 
                 should_exit = False
                 if sig.signals_received():
@@ -307,4 +346,7 @@ def pretrain(
     loop = TrainLoop(run_cfg, log=log)
     n_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(loop.state.params))
     log(f"mesh: {dict(loop.rt.mesh.shape)} | params: {n_params:,}")
-    return loop.train(train_iter_factory, valid_iter_factory)
+    try:
+        return loop.train(train_iter_factory, valid_iter_factory)
+    finally:
+        loop.writer.close()

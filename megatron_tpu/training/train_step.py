@@ -120,7 +120,21 @@ def make_eval_step(
     """Forward-only loss (ref: training.py evaluate loop, :773-826)."""
 
     def eval_step(params: Any, batch: Dict[str, jnp.ndarray]):
-        loss, aux = lm_loss(model_cfg, params, batch, sharder=sharder)
-        return {"lm_loss": loss, "ntokens": aux["ntokens"]}
+        from megatron_tpu.models.language_model import lm_forward
+        from megatron_tpu.ops.cross_entropy import cross_entropy_loss
+        from megatron_tpu.training.metrics import compute_metrics
+
+        logits = lm_forward(model_cfg, params, batch["tokens"],
+                            positions=batch.get("position_ids"),
+                            sharder=sharder)
+        loss_mask = batch.get("loss_mask")
+        if loss_mask is None:
+            loss_mask = jnp.ones(batch["labels"].shape, jnp.float32)
+        loss, per_token = cross_entropy_loss(logits, batch["labels"],
+                                             loss_mask=loss_mask)
+        out = {"lm_loss": loss, "ntokens": jnp.sum(loss_mask)}
+        out.update(compute_metrics(train_cfg.metrics, logits, batch["labels"],
+                                   loss_mask, per_token))
+        return out
 
     return eval_step

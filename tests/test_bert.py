@@ -1,0 +1,90 @@
+"""BERT model + dataset tests (counterparts: reference bert_model.py /
+bert_dataset.py paths, which have no unit tests of their own)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatron_tpu.data.bert_dataset import BertDataset
+from megatron_tpu.data.indexed_dataset import make_builder, make_dataset
+from megatron_tpu.models.bert import bert_config, bert_forward, bert_loss
+from megatron_tpu.models.params import init_params
+
+
+def _tiny_bert():
+    return bert_config(num_layers=2, hidden_size=32, num_attention_heads=4,
+                       vocab_size=128, seq_length=32,
+                       hidden_dropout=0.0, attention_dropout=0.0,
+                       params_dtype="float32")
+
+
+def test_bert_forward_shapes_and_padding_invariance():
+    cfg = _tiny_bert()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, 128, (2, 32)), jnp.int32)
+    mask = jnp.asarray(np.concatenate([np.ones((2, 20)), np.zeros((2, 12))], 1) > 0)
+    tt = jnp.asarray((np.arange(32) >= 10).astype(np.int64))[None, :].repeat(2, 0)
+    logits, binary = bert_forward(cfg, params, tokens, mask, tokentype_ids=tt)
+    assert logits.shape == (2, 32, 128)
+    assert binary.shape == (2, 2)
+
+    # changing tokens in padded positions must not change real-token logits
+    tokens2 = tokens.at[:, 25].set((tokens[:, 25] + 7) % 128)
+    logits2, _ = bert_forward(cfg, params, tokens2, mask, tokentype_ids=tt)
+    np.testing.assert_allclose(np.asarray(logits[:, :20]),
+                               np.asarray(logits2[:, :20]), rtol=1e-5, atol=1e-5)
+
+
+def test_bert_loss_runs():
+    cfg = _tiny_bert()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    batch = {
+        "tokens": jnp.asarray(rng.integers(0, 128, (2, 32)), jnp.int32),
+        "padding_mask": jnp.ones((2, 32), jnp.float32),
+        "tokentype_ids": jnp.zeros((2, 32), jnp.int32),
+        "labels": jnp.asarray(rng.integers(0, 128, (2, 32)), jnp.int32),
+        "loss_mask": jnp.asarray((rng.random((2, 32)) < 0.15), jnp.float32),
+        "is_random": jnp.asarray([0, 1], jnp.int32),
+    }
+    loss, aux = bert_loss(cfg, params, batch)
+    assert np.isfinite(float(loss))
+    assert "mlm_loss" in aux and "sop_loss" in aux
+    # grads flow to the heads
+    g = jax.grad(lambda p: bert_loss(cfg, p, batch)[0])(params)
+    assert float(jnp.abs(g["binary_head"]["w"]).sum()) > 0
+    assert float(jnp.abs(g["mlm_head"]["dense_w"]).sum()) > 0
+
+
+def test_bert_dataset_masking(tmp_path):
+    # sentence-level corpus: each doc has 3-6 sentences
+    prefix = str(tmp_path / "sents")
+    builder = make_builder(prefix, vocab_size=200)
+    rng = np.random.default_rng(0)
+    for _ in range(10):
+        for _ in range(rng.integers(3, 7)):
+            builder.add_item(rng.integers(10, 200, rng.integers(4, 12)))
+        builder.end_document()
+    builder.finalize(prefix + ".idx")
+    indexed = make_dataset(prefix)
+
+    ds = BertDataset(indexed, num_samples=20, max_seq_length=64,
+                     mask_token=4, cls_token=1, sep_token=2, pad_token=0,
+                     vocab_size=200, seed=3)
+    assert len(ds) > 0
+    item = ds[0]
+    assert item["tokens"].shape == (64,)
+    assert item["tokens"][0] == 1  # [CLS]
+    n_real = int(item["padding_mask"].sum())
+    assert n_real <= 64
+    # masked positions carry labels, everything else doesn't
+    masked = item["loss_mask"] > 0
+    assert masked.sum() >= 1
+    assert (item["labels"][~masked] == 0).all()
+    # some masked positions show [MASK]
+    assert (item["tokens"][masked] == 4).sum() >= 1
+    # deterministic per index
+    item2 = ds[0]
+    np.testing.assert_array_equal(item["tokens"], item2["tokens"])

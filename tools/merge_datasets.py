@@ -1,0 +1,40 @@
+#!/usr/bin/env python
+"""Merge multiple indexed datasets into one
+(ref: tools/merge_datasets.py, 66 LoC).
+
+  python tools/merge_datasets.py --input prefix_a prefix_b --output merged
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from megatron_tpu.data.indexed_dataset import (
+    MMapIndexedDataset, MMapIndexedDatasetBuilder, data_file_path,
+    index_file_path,
+)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--input", nargs="+", required=True,
+                   help="dataset prefixes to merge, in order")
+    p.add_argument("--output", required=True)
+    args = p.parse_args(argv)
+
+    first = MMapIndexedDataset(args.input[0])
+    builder = MMapIndexedDatasetBuilder(data_file_path(args.output),
+                                        dtype=first.dtype)
+    total = 0
+    for prefix in args.input:
+        builder.merge_file_(prefix)
+        total += len(MMapIndexedDataset(prefix))
+    builder.finalize(index_file_path(args.output))
+    print(f"merged {len(args.input)} datasets ({total} sequences) "
+          f"into {args.output}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Start the REST generation server on a trained checkpoint.
+
+Equivalent of the reference's tools/run_text_generation_server.py (84 LoC) —
+without the rank>0 worker loop (single-controller JAX needs none).
+
+  python tools/run_text_generation_server.py --load ckpts --model_name tiny \
+      --tokenizer_type null --vocab_size 128 --port 5000
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from megatron_tpu.platform import ensure_platform
+
+ensure_platform()
+
+
+def extra_args(parser):
+    g = parser.add_argument_group("server")
+    g.add_argument("--host", default="0.0.0.0")
+    g.add_argument("--port", type=int, default=5000)
+    return parser
+
+
+def main(argv=None):
+    import jax
+
+    from megatron_tpu.arguments import args_to_run_config, parse_args
+    from megatron_tpu.inference.server import run_server
+    from megatron_tpu.models.params import init_params
+    from megatron_tpu.tokenizer import build_tokenizer
+    from megatron_tpu.training import checkpointing
+
+    args = parse_args(argv, extra_args_provider=extra_args)
+    cfg = args_to_run_config(args)
+    tokenizer = build_tokenizer(
+        args.tokenizer_type, vocab_file=args.vocab_file,
+        merges_file=args.merges_file, tokenizer_model=args.tokenizer_model,
+        vocab_size=args.vocab_size)
+
+    params = init_params(cfg.model, jax.random.PRNGKey(cfg.training.seed))
+    if cfg.training.load:
+        params = checkpointing.load_params_only(cfg.training.load, params)
+        print(f"loaded checkpoint at iteration "
+              f"{checkpointing.read_tracker(cfg.training.load)}")
+    else:
+        print("WARNING: serving randomly initialized weights (no --load)")
+
+    run_server(cfg.model, params, tokenizer, host=args.host, port=args.port)
+
+
+if __name__ == "__main__":
+    main()

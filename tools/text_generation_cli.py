@@ -1,0 +1,34 @@
+#!/usr/bin/env python
+"""Interactive client for the generation server
+(ref: tools/text_generation_cli.py, 23 LoC — urllib instead of requests).
+
+  python tools/text_generation_cli.py localhost:5000
+"""
+
+import json
+import sys
+import urllib.request
+
+
+def main():
+    if len(sys.argv) < 2:
+        raise SystemExit("usage: text_generation_cli.py host:port")
+    url = f"http://{sys.argv[1]}/api"
+    while True:
+        try:
+            prompt = input("Enter prompt: ")
+        except EOFError:
+            break
+        if not prompt:
+            continue
+        body = json.dumps({"prompts": [prompt],
+                           "tokens_to_generate": 64}).encode()
+        req = urllib.request.Request(url, data=body, method="PUT",
+                                     headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as resp:
+            out = json.loads(resp.read())
+        print("Megatron-TPU:", out["text"][0])
+
+
+if __name__ == "__main__":
+    main()
