@@ -1,0 +1,24 @@
+#!/bin/bash
+# Llama-2-7B finetune on a v5p-8 host slice, TP=4 + SP + ZeRO-1
+# (counterpart of the reference's docs/guide/getting_started.md recipe:
+# 8x A100, DP2*TP4, bf16, flash-attn, sequence parallel, selective recompute)
+set -e
+
+python tools/hf_to_native.py --model meta-llama/Llama-2-7b-hf \
+    --output ckpts/llama2-7b
+
+python verify_correctness.py --model meta-llama/Llama-2-7b-hf \
+    --load ckpts/llama2-7b --iters 10 --batch 2 --seq 512
+
+python finetune.py \
+    --model_name llama2-7B --load ckpts/llama2-7b --finetune \
+    --data_path data/corpus --data_type gpt --split 969,30,1 \
+    --tensor_model_parallel_size 4 --sequence_parallel \
+    --use_distributed_optimizer \
+    --micro_batch_size 2 --global_batch_size 1000 \
+    --seq_length 1024 --train_iters 500 \
+    --lr 2e-5 --min_lr 2e-6 --lr_decay_style cosine --lr_warmup_iters 50 \
+    --weight_decay 0.1 --clip_grad 1.0 --bf16 \
+    --attention_impl pallas --recompute_granularity selective \
+    --save ckpts/tuned --save_interval 100 --log_interval 10 \
+    --eval_interval 100 --eval_iters 10 --metrics perplexity accuracy

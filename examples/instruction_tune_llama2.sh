@@ -1,0 +1,20 @@
+#!/bin/bash
+# Instruction tuning with assistant-token loss masking
+# (counterpart of docs/guide/instruction_tuning.md: GBS 64, ~3 epochs)
+set -e
+
+python tools/preprocess_instruct_data.py \
+    --input data/orca.jsonl --output_prefix data/orca \
+    --tokenizer_type SentencePieceTokenizer --tokenizer_model tokenizer.model
+
+python finetune.py \
+    --model_name llama2-7B --load ckpts/llama2-7b --finetune \
+    --data_path data/orca --data_type instruction \
+    --scalar_loss_mask 0.0 --pad_token_id 0 \
+    --tensor_model_parallel_size 4 --sequence_parallel \
+    --use_distributed_optimizer \
+    --micro_batch_size 2 --global_batch_size 64 --train_iters 6500 \
+    --lr 2e-5 --lr_decay_style cosine --lr_warmup_iters 100 --bf16 \
+    --attention_impl pallas --recompute_granularity selective \
+    --save ckpts/orca --save_interval 500 --log_interval 10 \
+    --metrics instruct_accuracy

@@ -1,0 +1,16 @@
+#!/bin/bash
+# Mistral-style pretrain with sliding-window attention and ring-attention
+# context parallelism for 32k sequences (beyond reference parity — the
+# reference has no context-parallel path)
+set -e
+
+python pretrain_gpt.py \
+    --model_name mistral-7B --seq_length 32768 \
+    --data_path data/corpus --split 989,10,1 \
+    --tensor_model_parallel_size 4 --context_parallel_size 4 \
+    --sequence_parallel --use_distributed_optimizer \
+    --attention_impl ring \
+    --micro_batch_size 1 --global_batch_size 64 --train_iters 10000 \
+    --lr 3e-4 --lr_decay_style cosine --lr_warmup_iters 500 --bf16 \
+    --recompute_granularity selective \
+    --save ckpts/mistral --save_interval 1000

@@ -71,7 +71,8 @@ def generate_and_post_process(
         cfg, params, prompt_tokens, lengths,
         max_new_tokens=tokens_to_generate,
         temperature=temperature, top_k=top_k_sampling, top_p=top_p_sampling,
-        vocab_size=tokenizer.vocab_size, eod=tokenizer.eod, seed=random_seed)
+        vocab_size=tokenizer.vocab_size, eod=tokenizer.eod, seed=random_seed,
+        want_logprobs=return_output_log_probs)
 
     texts, segments = [], []
     for row, end in zip(out.tokens, out.lengths):

@@ -40,7 +40,8 @@ def _init_caches(cfg: ModelConfig, batch: int, total_len: int):
 
 
 @partial(jax.jit, static_argnames=("cfg", "total_len", "temperature", "top_k",
-                                   "top_p", "vocab_size", "eod"))
+                                   "top_p", "vocab_size", "eod",
+                                   "want_logprobs"))
 def _generate_jit(
     cfg: ModelConfig,
     params: Any,
@@ -53,6 +54,7 @@ def _generate_jit(
     top_p: float,
     vocab_size: Optional[int],
     eod: Optional[int],
+    want_logprobs: bool = True,
 ):
     B = tokens.shape[0]
     min_len = jnp.min(lengths)
@@ -70,7 +72,10 @@ def _generate_jit(
         positions=positions[:, :prefill_len],
         kv_caches=caches, cache_index=0)
 
-    logprobs_all = jax.nn.log_softmax(logits_all.astype(jnp.float32), axis=-1)
+    # the full-prefill fp32 log_softmax ([B, S, V]) is only paid when the
+    # caller wants per-token logprobs
+    logprobs_all = (jax.nn.log_softmax(logits_all.astype(jnp.float32), axis=-1)
+                    if want_logprobs else None)
 
     # carry: (t, tokens, caches, done, key, logprobs, last_logits)
     def body2(carry):
@@ -108,10 +113,12 @@ def _generate_jit(
 
     # teacher-forced logprobs for the prompt region
     lp0 = jnp.zeros((B, total_len - 1), jnp.float32)
-    prompt_lp = jnp.take_along_axis(
-        logprobs_all, tokens[:, 1:prefill_len + 1][..., None], axis=-1)[..., 0]
-    valid = (jnp.arange(1, prefill_len + 1)[None, :] < lengths[:, None])
-    lp0 = lp0.at[:, :prefill_len].set(jnp.where(valid, prompt_lp, 0.0))
+    if want_logprobs:
+        prompt_lp = jnp.take_along_axis(
+            logprobs_all, tokens[:, 1:prefill_len + 1][..., None],
+            axis=-1)[..., 0]
+        valid = (jnp.arange(1, prefill_len + 1)[None, :] < lengths[:, None])
+        lp0 = lp0.at[:, :prefill_len].set(jnp.where(valid, prompt_lp, 0.0))
 
     done0 = jnp.zeros((B,), bool)
     carry = (min_len, tokens, caches, done0, key, lp0, first_logits)
@@ -142,15 +149,22 @@ def generate_tokens(
     vocab_size: Optional[int] = None,
     eod: Optional[int] = None,
     seed: int = 0,
+    want_logprobs: bool = True,
 ) -> GenerationOutput:
     B, max_prompt = prompts.shape
     total_len = max_prompt + max_new_tokens
+    if (cfg.position_embedding_type == "absolute"
+            and total_len > (cfg.max_position_embeddings or 0)):
+        raise ValueError(
+            f"prompt + tokens_to_generate = {total_len} exceeds "
+            f"max_position_embeddings {cfg.max_position_embeddings} — "
+            "absolute position embeddings would silently clamp")
     tokens = np.zeros((B, total_len), np.int32)
     tokens[:, :max_prompt] = prompts
     toks, ends, lp = _generate_jit(
         cfg, params, jnp.asarray(tokens), jnp.asarray(lengths, jnp.int32),
         jax.random.PRNGKey(seed), total_len, float(temperature), int(top_k),
-        float(top_p), vocab_size, eod)
+        float(top_p), vocab_size, eod, want_logprobs)
     return GenerationOutput(tokens=np.asarray(toks), lengths=np.asarray(ends),
                             logprobs=np.asarray(lp))
 

@@ -1,0 +1,224 @@
+"""T5-style encoder-decoder model.
+
+Equivalent of megatron/model/t5_model.py (198 LoC): like the reference's
+T5, this uses BERT-style absolute learned position embeddings (not T5
+relative bias), a bidirectional padding-masked encoder, a causal decoder
+with cross-attention to the encoder output, shared input embeddings and a
+tied LM head over the decoder.
+
+The encoder/decoder blocks reuse the framework ops directly; parameters
+live in a dedicated tree (this model's cross-attention has no counterpart
+in the decoder-only template).
+"""
+
+from __future__ import annotations
+
+import math
+import zlib
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from megatron_tpu.config import ModelConfig
+from megatron_tpu.ops.activations import apply_activation, mlp_input_width_factor
+from megatron_tpu.ops.attention import attention
+from megatron_tpu.ops.cross_entropy import cross_entropy_loss
+from megatron_tpu.ops.normalization import norm_forward
+
+
+def t5_config(
+    num_layers: int = 12,          # encoder layers == decoder layers (ref)
+    hidden_size: int = 768,
+    num_attention_heads: int = 12,
+    vocab_size: int = 30592,
+    seq_length: int = 512,
+    decoder_seq_length: int = 128,
+    **kw,
+) -> ModelConfig:
+    base = dict(
+        num_layers=num_layers, hidden_size=hidden_size,
+        num_attention_heads=num_attention_heads, vocab_size=vocab_size,
+        seq_length=seq_length, max_position_embeddings=max(seq_length,
+                                                           decoder_seq_length),
+        position_embedding_type="absolute",
+        normalization="layernorm", activation="gelu",
+        use_bias_linear=True, use_bias_qkv=True,
+        tie_embed_logits=True, attn_mask_type="padding",
+    )
+    base.update(kw)
+    return ModelConfig(**base).validate()
+
+
+# ---------------------------------------------------------------------------
+# params
+# ---------------------------------------------------------------------------
+
+
+def t5_param_shapes(cfg: ModelConfig) -> Dict[str, tuple]:
+    h, L = cfg.hidden_size, cfg.num_layers
+    D, nq = cfg.head_dim, cfg.num_attention_heads
+    F = cfg.ffn_size * mlp_input_width_factor(cfg.activation)
+    Fo = cfg.ffn_size
+    d: Dict[str, tuple] = {
+        "embed/tokens": (cfg.vocab_size, h),
+        "embed/pos": (cfg.max_position_embeddings, h),
+    }
+
+    def attn_block(prefix: str):
+        for n in ("wq", "wk", "wv"):
+            d[f"{prefix}/{n}"] = (L, h, nq * D)
+            if cfg.use_bias_qkv:
+                d[f"{prefix}/{n}_b"] = (L, nq * D)
+        d[f"{prefix}/wo"] = (L, nq * D, h)
+        if cfg.use_bias_linear:
+            d[f"{prefix}/wo_b"] = (L, h)
+
+    def stack(side: str, cross: bool):
+        d[f"{side}/ln1/scale"] = (L, h)
+        d[f"{side}/ln1/bias"] = (L, h)
+        attn_block(f"{side}/attn")
+        if cross:
+            d[f"{side}/ln_cross/scale"] = (L, h)
+            d[f"{side}/ln_cross/bias"] = (L, h)
+            attn_block(f"{side}/cross")
+        d[f"{side}/ln2/scale"] = (L, h)
+        d[f"{side}/ln2/bias"] = (L, h)
+        d[f"{side}/mlp/w_in"] = (L, h, F)
+        if cfg.use_bias_linear:
+            d[f"{side}/mlp/w_in_b"] = (L, F)
+        d[f"{side}/mlp/w_out"] = (L, Fo, h)
+        if cfg.use_bias_linear:
+            d[f"{side}/mlp/w_out_b"] = (L, h)
+
+    stack("encoder", cross=False)
+    stack("decoder", cross=True)
+    d["encoder/final_ln/scale"] = (h,)
+    d["encoder/final_ln/bias"] = (h,)
+    d["decoder/final_ln/scale"] = (h,)
+    d["decoder/final_ln/bias"] = (h,)
+    return d
+
+
+def t5_init_params(cfg: ModelConfig, key: jax.Array) -> Dict[str, Any]:
+    shapes = t5_param_shapes(cfg)
+    scaled_std = cfg.init_method_std / math.sqrt(2.0 * cfg.num_layers)
+    flat = {}
+    for path, shape in sorted(shapes.items()):
+        if path.endswith("scale"):
+            flat[path] = jnp.ones(shape, cfg.dtype)
+        elif path.endswith("bias") or path.endswith("_b"):
+            flat[path] = jnp.zeros(shape, cfg.dtype)
+        else:
+            std = scaled_std if path.endswith(("wo", "w_out")) else cfg.init_method_std
+            k = jax.random.fold_in(key, zlib.crc32(path.encode()) & 0x7FFFFFFF)
+            flat[path] = (jax.random.normal(k, shape, jnp.float32) * std).astype(cfg.dtype)
+    out: Dict[str, Any] = {}
+    for path, v in flat.items():
+        node = out
+        parts = path.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    return out
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _proj(x, p, name):
+    out = jnp.einsum("bsh,hd->bsd", x, p[name])
+    if f"{name}_b" in p:
+        out = out + p[f"{name}_b"]
+    return out
+
+
+def _attn(cfg, p, x_q, x_kv, mask_type, padding_mask):
+    b, sq, h = x_q.shape
+    D, nq = cfg.head_dim, cfg.num_attention_heads
+    q = _proj(x_q, p, "wq").reshape(b, sq, nq, D)
+    k = _proj(x_kv, p, "wk").reshape(b, x_kv.shape[1], nq, D)
+    v = _proj(x_kv, p, "wv").reshape(b, x_kv.shape[1], nq, D)
+    ctx = attention(q, k, v, mask_type=mask_type, padding_mask=padding_mask,
+                    softmax_fp32=cfg.softmax_fp32)
+    out = jnp.einsum("bsd,dh->bsh", ctx.reshape(b, sq, nq * D), p["wo"])
+    if "wo_b" in p:
+        out = out + p["wo_b"]
+    return out
+
+
+def _mlp(cfg, p, x):
+    hdn = jnp.einsum("bsh,hf->bsf", x, p["w_in"])
+    if "w_in_b" in p:
+        hdn = hdn + p["w_in_b"]
+    hdn = apply_activation(cfg.activation, hdn)
+    out = jnp.einsum("bsf,fh->bsh", hdn, p["w_out"])
+    if "w_out_b" in p:
+        out = out + p["w_out_b"]
+    return out
+
+
+def _embed(cfg, params, tokens):
+    pos = jnp.arange(tokens.shape[1])[None, :]
+    return (jnp.take(params["embed"]["tokens"], tokens, axis=0)
+            + jnp.take(params["embed"]["pos"], pos, axis=0))
+
+
+def _norm(cfg, p, x):
+    return norm_forward(cfg.normalization, x, p["scale"], p.get("bias"),
+                        cfg.layernorm_epsilon)
+
+
+def t5_forward(
+    cfg: ModelConfig,
+    params: Dict[str, Any],
+    enc_tokens: jnp.ndarray,        # [B, Se]
+    dec_tokens: jnp.ndarray,        # [B, Sd]
+    enc_padding_mask: jnp.ndarray,  # [B, Se] True = real
+) -> jnp.ndarray:
+    """Returns decoder LM logits [B, Sd, V]."""
+    enc = params["encoder"]
+
+    def enc_layer(x, lp):
+        x = x + _attn(cfg, lp["attn"], _norm(cfg, lp["ln1"], x),
+                      _norm(cfg, lp["ln1"], x), "bidirectional",
+                      enc_padding_mask)
+        x = x + _mlp(cfg, lp["mlp"], _norm(cfg, lp["ln2"], x))
+        return x, None
+
+    x = _embed(cfg, params, enc_tokens)
+    x, _ = jax.lax.scan(enc_layer, x,
+                        {k: enc[k] for k in ("ln1", "attn", "ln2", "mlp")})
+    enc_out = _norm(cfg, enc["final_ln"], x)
+
+    dec = params["decoder"]
+
+    def dec_layer(y, lp):
+        y = y + _attn(cfg, lp["attn"], _norm(cfg, lp["ln1"], y),
+                      _norm(cfg, lp["ln1"], y), "causal", None)
+        y = y + _attn(cfg, lp["cross"], _norm(cfg, lp["ln_cross"], y),
+                      enc_out, "bidirectional", enc_padding_mask)
+        y = y + _mlp(cfg, lp["mlp"], _norm(cfg, lp["ln2"], y))
+        return y, None
+
+    y = _embed(cfg, params, dec_tokens)
+    y, _ = jax.lax.scan(
+        dec_layer, y,
+        {k: dec[k] for k in ("ln1", "attn", "ln_cross", "cross", "ln2", "mlp")})
+    y = _norm(cfg, dec["final_ln"], y)
+    return jnp.einsum("bsh,vh->bsv", y, params["embed"]["tokens"])
+
+
+def t5_loss(
+    cfg: ModelConfig,
+    params: Dict[str, Any],
+    batch: Dict[str, jnp.ndarray],
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """batch: enc_tokens, enc_padding_mask, dec_tokens, labels, loss_mask."""
+    logits = t5_forward(cfg, params, batch["enc_tokens"], batch["dec_tokens"],
+                        batch["enc_padding_mask"] > 0)
+    loss, _ = cross_entropy_loss(logits, batch["labels"],
+                                 loss_mask=batch.get("loss_mask"))
+    return loss, {"lm_loss": loss}

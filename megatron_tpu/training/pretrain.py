@@ -122,6 +122,8 @@ class TrainLoop:
         self._sharder = sharder
         self._step_cache: Dict[int, Callable] = {}
         self.eval_step = None
+        # task entry points (BERT/T5) set this to their loss for evaluate()
+        self.eval_loss_fn = None
 
         from megatron_tpu.training.logging_writer import Writer
 
@@ -207,7 +209,8 @@ class TrainLoop:
         """Forward-only eval (ref: training.py:773-826)."""
         if self.eval_step is None:
             es = make_eval_step(self.cfg.model, self.cfg.training,
-                                sharder=self._sharder)
+                                sharder=self._sharder,
+                                loss_fn=self.eval_loss_fn)
             self.eval_step = jax.jit(es)
         total, count = 0.0, 0
         extras: Dict[str, float] = {}

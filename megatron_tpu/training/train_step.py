@@ -116,8 +116,21 @@ def make_eval_step(
     model_cfg: ModelConfig,
     train_cfg: TrainingConfig,
     sharder: Sharder = _identity_sharder,
+    loss_fn: Optional[Callable] = None,
 ):
-    """Forward-only loss (ref: training.py evaluate loop, :773-826)."""
+    """Forward-only loss (ref: training.py evaluate loop, :773-826).
+
+    loss_fn(model_cfg, params, batch) -> (loss, aux) overrides the GPT LM
+    loss for task models (BERT/T5), mirroring make_train_step's loss_fn."""
+
+    if loss_fn is not None:
+        def task_eval_step(params: Any, batch: Dict[str, jnp.ndarray]):
+            loss, aux = loss_fn(model_cfg, params, batch)
+            out = {"lm_loss": loss}
+            out.update({k: v for k, v in aux.items() if k != "loss"})
+            return out
+
+        return task_eval_step
 
     def eval_step(params: Any, batch: Dict[str, jnp.ndarray]):
         from megatron_tpu.models.language_model import lm_forward

@@ -104,6 +104,8 @@ def main(argv=None):
         return loop._step_cache[n_micro]
 
     loop._train_step_for = step_for
+    loop.eval_loss_fn = lambda mc, p, b: bert_loss(mc, p, b,
+                                                   sharder=loop._sharder)
     loop.train(train_iter_factory)
 
 
