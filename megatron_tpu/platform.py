@@ -10,6 +10,30 @@ initialize a backend.
 from __future__ import annotations
 
 import os
+import re
+
+
+def force_cpu(n_devices: int = 8) -> None:
+    """Force the CPU platform with >= n_devices virtual devices.
+
+    Must run before the first jax API call that initializes a backend —
+    sitecustomize may pin a TPU plugin via JAX_PLATFORMS, making env vars
+    set later ineffective. Mutates os.environ (callers that must not leak
+    the override into child processes should snapshot/restore around this).
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    count = max(n_devices, int(m.group(1)) if m else 0)
+    flag = f"--xla_force_host_platform_device_count={count}"
+    if m:
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", flag, flags)
+    else:
+        flags = (flags + " " + flag).strip()
+    os.environ["XLA_FLAGS"] = flags
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 
 def ensure_platform() -> None:
