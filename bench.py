@@ -38,7 +38,7 @@ def main():
     cfg = presets.tiny(
         vocab_size=32000, seq_length=2048, hidden_size=2048, num_layers=10,
         num_attention_heads=16, num_kv_heads=16, ffn_hidden_size=5504,
-        params_dtype="bfloat16",
+        params_dtype="bfloat16", attention_impl="pallas",
     )
     n_params = num_params(cfg)
 

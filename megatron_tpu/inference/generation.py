@@ -39,7 +39,8 @@ def _init_caches(cfg: ModelConfig, batch: int, total_len: int):
     return (jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
 
 
-@partial(jax.jit, static_argnames=("cfg", "total_len", "temperature", "top_k",
+@partial(jax.jit, static_argnames=("cfg", "total_len", "prefill_len",
+                                   "temperature", "top_k",
                                    "top_p", "vocab_size", "eod",
                                    "want_logprobs"))
 def _generate_jit(
@@ -49,6 +50,7 @@ def _generate_jit(
     lengths: jnp.ndarray,   # [B] prompt lengths
     key: jax.Array,
     total_len: int,
+    prefill_len: int,
     temperature: float,
     top_k: int,
     top_p: float,
@@ -60,13 +62,13 @@ def _generate_jit(
     min_len = jnp.min(lengths)
     caches = _init_caches(cfg, B, total_len)
 
-    # prefill [0, min_len) in one pass — the reference likewise batches the
-    # common prompt prefix
+    # Prefill the prompt region in one pass — the reference likewise batches
+    # the common prompt prefix. min_len is dynamic, so the prefill runs a
+    # *static* bucketed length covering every prompt (>= max prompt length,
+    # rounded up by the caller so a 5-token prompt with 2000 new tokens does
+    # not pay a 2000-position prefill); decode overwrites cache entries for
+    # positions it re-runs, with identical forced-token values.
     positions = jnp.arange(total_len)[None, :]
-    # pad the prefill to a static length (min_len is dynamic): run the full
-    # prompt region once with cache_index=0 and pick logits at min_len-1.
-    # Static shapes beat a dynamic-length prefill on TPU.
-    prefill_len = total_len - 1
     logits_all, caches = lm_forward(
         cfg, params, tokens[:, :prefill_len],
         positions=positions[:, :prefill_len],
@@ -161,10 +163,13 @@ def generate_tokens(
             "absolute position embeddings would silently clamp")
     tokens = np.zeros((B, total_len), np.int32)
     tokens[:, :max_prompt] = prompts
+    # bucketed static prefill length: covers the longest prompt, rounded up
+    # to 64 so nearby prompt lengths share a compile
+    prefill_len = min(total_len - 1, max(1, -(-max_prompt // 64) * 64))
     toks, ends, lp = _generate_jit(
         cfg, params, jnp.asarray(tokens), jnp.asarray(lengths, jnp.int32),
-        jax.random.PRNGKey(seed), total_len, float(temperature), int(top_k),
-        float(top_p), vocab_size, eod, want_logprobs)
+        jax.random.PRNGKey(seed), total_len, prefill_len, float(temperature),
+        int(top_k), float(top_p), vocab_size, eod, want_logprobs)
     return GenerationOutput(tokens=np.asarray(toks), lengths=np.asarray(ends),
                             logprobs=np.asarray(lp))
 
@@ -195,9 +200,25 @@ def beam_search_tokens(
     plen = len(prompt)
     total = plen + max_new_tokens
 
-    @partial(jax.jit, static_argnames=())
-    def step_logits(toks):
-        return lm_forward(cfg, params, toks)[:, -1]  # [beams, V]
+    # Incremental decode on the same cached path as sampling (ref beam
+    # search shares the cached ForwardStep, text_generation/generation.py:288):
+    # prefill the prompt once at batch 1, tile the caches across beams, then
+    # one single-token forward per emitted token with per-beam cache
+    # reordering (gather over the batch axis) at each step.
+    caches = _init_caches(cfg, 1, total)
+    prefill_logits, caches = lm_forward(
+        cfg, params, jnp.asarray(prompt)[None, :],
+        positions=jnp.arange(plen)[None, :], kv_caches=caches, cache_index=0)
+    caches = jax.tree.map(lambda c: jnp.repeat(c, beam_size, axis=1), caches)
+    step_logits_dev = jnp.repeat(prefill_logits[:, -1], beam_size, axis=0)
+
+    @jax.jit
+    def decode_step(caches, parents, toks, t):
+        caches = jax.tree.map(lambda c: jnp.take(c, parents, axis=1), caches)
+        pos = jnp.full((beam_size, 1), t, jnp.int32)
+        logits, caches = lm_forward(cfg, params, toks[:, None], positions=pos,
+                                    kv_caches=caches, cache_index=t)
+        return logits[:, 0], caches
 
     beams = np.tile(prompt[None, :], (beam_size, 1))
     scores = np.full((beam_size,), -1e9, np.float64)
@@ -205,14 +226,14 @@ def beam_search_tokens(
     finished = []  # (score_with_penalty, tokens) — BeamHypotheses equivalent
 
     for t in range(plen, total):
-        logits = np.asarray(step_logits(jnp.asarray(beams)), np.float64)
+        logits = np.asarray(step_logits_dev, np.float64)
         logprobs = logits - np.log(np.exp(logits - logits.max(-1, keepdims=True))
                                    .sum(-1, keepdims=True)) - logits.max(-1, keepdims=True)
         cand = scores[:, None] + logprobs  # [beams, V]
         flat = cand.reshape(-1)
         top = np.argpartition(-flat, 2 * beam_size)[: 2 * beam_size]
         top = top[np.argsort(-flat[top])]
-        new_beams, new_scores = [], []
+        new_beams, new_scores, parents, new_toks = [], [], [], []
         for idx in top:
             b, v = divmod(int(idx), logits.shape[-1])
             seq = np.concatenate([beams[b], [v]])
@@ -222,6 +243,8 @@ def beam_search_tokens(
             else:
                 new_beams.append(seq)
                 new_scores.append(flat[idx])
+                parents.append(b)
+                new_toks.append(v)
             if len(new_beams) == beam_size:
                 break
         beams = np.stack([np.pad(s, (0, total - len(s))) for s in new_beams])[:, :t + 1]
@@ -231,6 +254,10 @@ def beam_search_tokens(
             worst_kept = sorted(finished, key=lambda x: -x[0])[beam_size - 1][0]
             if worst_kept >= best_possible:
                 break
+        if t + 1 < total:
+            step_logits_dev, caches = decode_step(
+                caches, jnp.asarray(parents, jnp.int32),
+                jnp.asarray(new_toks, jnp.int32), jnp.int32(t))
 
     for s, b in zip(scores, beams):
         penalty = (max(1, beams.shape[1] - plen) ** length_penalty)

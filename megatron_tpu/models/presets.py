@@ -34,6 +34,10 @@ def _llama_base(**kw) -> ModelConfig:
         tie_embed_logits=False,
         layernorm_epsilon=1e-5,
         vocab_size=32000,
+        # flash (splash) attention on the training path, like the reference's
+        # recommended --use_flash_attn configs; dispatch falls back to the
+        # XLA path for shapes the kernel doesn't cover (decode, padding)
+        attention_impl="pallas",
     )
     base.update(kw)
     return ModelConfig(**base).validate()
@@ -112,6 +116,7 @@ def falcon(size: str = "7B", seq_length: int = 2048) -> ModelConfig:
         parallel_attn=True, parallel_layernorm=parallel_ln,
         use_bias_linear=False, use_bias_qkv=False,
         tie_embed_logits=True, layernorm_epsilon=1e-5,
+        attention_impl="pallas",
     ).validate()
 
 

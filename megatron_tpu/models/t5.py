@@ -100,6 +100,22 @@ def t5_param_shapes(cfg: ModelConfig) -> Dict[str, tuple]:
     return d
 
 
+def t5_param_specs(cfg: ModelConfig) -> Dict[str, Any]:
+    """Sharding specs matching t5_init_params's tree. Replicated (pure-DP)
+    for now — T5 tensor-parallel specs are a follow-up; the GPT family is
+    the TP-first path."""
+    from jax.sharding import PartitionSpec as P
+
+    out: Dict[str, Any] = {}
+    for path in t5_param_shapes(cfg):
+        node = out
+        parts = path.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = P()
+    return out
+
+
 def t5_init_params(cfg: ModelConfig, key: jax.Array) -> Dict[str, Any]:
     shapes = t5_param_shapes(cfg)
     scaled_std = cfg.init_method_std / math.sqrt(2.0 * cfg.num_layers)

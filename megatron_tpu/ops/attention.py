@@ -93,6 +93,11 @@ def attention(
             and padding_mask is None
             and q.shape[1] == k.shape[1]
             and mask_type == "causal"
+            # on CPU hosts the kernel would run under the pallas
+            # interpreter — orders of magnitude slower than the fused XLA
+            # path; presets default to impl='pallas', so CPU sanity runs
+            # must not pay that (tests exercise the kernels directly)
+            and jax.default_backend() != "cpu"
         )
         if can_use:
             try:

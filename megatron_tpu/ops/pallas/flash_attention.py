@@ -24,7 +24,6 @@ does not cover (sequence not divisible by the block size, decode steps).
 from __future__ import annotations
 
 import functools
-import os
 from typing import Optional, Tuple
 
 import jax
@@ -314,6 +313,52 @@ def supported(q_len: int, kv_len: int, block_q: int = DEFAULT_BLOCK,
             and kv_len % block_k == 0)
 
 
+def _pick_block(s: int, cap: int = 512) -> Optional[int]:
+    for b in (cap, 256, 128):
+        if b <= s and s % b == 0:
+            return b
+    return s if s % 128 == 0 else None
+
+
+def _splash_attention(q, k, v, causal: bool, window: Optional[int]):
+    """jax's bundled splash (block-sparse flash) kernel in MQA form:
+    q [B,Hq,S,D] grouped as [B,Hkv,G,S,D] so GQA shares K/V per group with
+    NO kv-head replication; masked-out blocks (beyond the causal frontier /
+    outside the sliding window) are skipped entirely, not just masked."""
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as sk,
+        splash_attention_mask as sm,
+    )
+
+    b, hq, s, d = q.shape
+    hkv = k.shape[1]
+    groups = hq // hkv
+    blk = _pick_block(s)
+    if blk is None:
+        raise ValueError(f"splash kernel needs seq % 128 == 0 ({s=})")
+
+    if window is not None:
+        # Mistral semantics: attend to at most the last `window` positions
+        # (self + window-1 back); LocalMask((left, right)) keeps
+        # q-left <= k <= q+right
+        head_mask = sm.LocalMask((s, s), (window - 1, 0), 0)
+    elif causal:
+        head_mask = sm.CausalMask((s, s))
+    else:
+        head_mask = sm.FullMask((s, s))
+    mask = sm.MultiHeadMask([head_mask] * groups)
+    bs = sk.BlockSizes(
+        block_q=blk, block_kv=blk, block_kv_compute=blk,
+        block_q_dkv=blk, block_kv_dkv=blk, block_kv_dkv_compute=blk,
+        block_q_dq=blk, block_kv_dq=blk)
+    kern = sk.make_splash_mqa_single_device(mask, block_sizes=bs,
+                                            interpret=_interpret())
+    scale = 1.0 / (d ** 0.5)
+    qg = (q * jnp.asarray(scale, q.dtype)).reshape(b, hkv, groups, s, d)
+    out = jax.vmap(jax.vmap(kern))(qg, k, v)         # [B,Hkv,G,S,D]
+    return out.reshape(b, hq, s, d)
+
+
 def flash_attention(
     q: jnp.ndarray,  # [B, Sq, Hq, D]
     k: jnp.ndarray,  # [B, Skv, Hkv, D]
@@ -323,47 +368,44 @@ def flash_attention(
     block_q: int = DEFAULT_BLOCK,
     block_k: int = DEFAULT_BLOCK,
 ) -> jnp.ndarray:
-    """Public entry in framework layout; GQA via kv-head broadcast.
+    """Public entry in framework layout.
 
-    Dispatch: the plain-causal case uses jax's bundled TPU flash kernel
-    (jax.experimental.pallas.ops.tpu.flash_attention) — the analogue of the
-    reference depending on the flash-attn library; the sliding-window case
-    (Mistral), which the bundled kernel does not support, uses the in-tree
-    kernel above."""
+    Dispatch: on TPU, jax's bundled splash-attention kernel — the analogue
+    of the reference depending on the flash-attn library
+    (megatron/model/transformer.py:524-553) — covering causal, sliding
+    window (transformer.py:528-536) and GQA with grouped (not replicated)
+    K/V. The in-tree kernel above serves the CPU/interpret test path and
+    any shape splash rejects."""
     b, sq, hq, d = q.shape
     _, skv, hkv, _ = k.shape
+    groups = hq // hkv
+
+    if not _interpret():
+        # splash accepts any seq divisible by 128 (its own block pick)
+        if sq != skv or _pick_block(sq) is None:
+            raise ValueError(
+                f"splash kernel needs equal seq lens divisible by 128 "
+                f"({sq=}, {skv=})")
+        qt = jnp.transpose(q, (0, 2, 1, 3))          # [B,Hq,S,D]
+        kt = jnp.transpose(k, (0, 2, 1, 3))          # [B,Hkv,S,D]
+        vt = jnp.transpose(v, (0, 2, 1, 3))
+        o = _splash_attention(qt, kt, vt, causal, sliding_window)
+        return jnp.transpose(o, (0, 2, 1, 3))
+
     block_q = min(block_q, sq)
     block_k = min(block_k, skv)
     if not supported(sq, skv, block_q, block_k):
         raise ValueError(
             f"flash kernel needs equal seq lens divisible by the block "
             f"({sq=}, {skv=}, {block_q=}, {block_k=})")
-    groups = hq // hkv
 
     qt = jnp.transpose(q, (0, 2, 1, 3))              # [B,Hq,S,D]
-    kt = jnp.transpose(k, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))              # [B,Hkv,S,D]
     vt = jnp.transpose(v, (0, 2, 1, 3))
+
     if groups > 1:
         kt = jnp.repeat(kt, groups, axis=1)
         vt = jnp.repeat(vt, groups, axis=1)
-
-    if (sliding_window is not None and not _interpret()
-            and os.environ.get("MEGATRON_TPU_WINDOW_KERNEL") != "1"):
-        # The in-tree windowed kernel exhibits pathological Mosaic compile
-        # times at large grids on the current toolchain; until that is fixed
-        # it is opt-in (MEGATRON_TPU_WINDOW_KERNEL=1) and this raises so the
-        # attention dispatch falls back to the XLA masked path.
-        raise ValueError("windowed flash kernel disabled "
-                         "(set MEGATRON_TPU_WINDOW_KERNEL=1 to enable)")
-
-    if sliding_window is None and causal and not _interpret():
-        from jax.experimental.pallas.ops.tpu.flash_attention import (
-            flash_attention as jax_flash,
-        )
-
-        o = jax_flash(qt, kt, vt, causal=True, sm_scale=float(1.0 / (d ** 0.5)))
-        return jnp.transpose(o, (0, 2, 1, 3))
-
     scale = float(1.0 / (d ** 0.5))
     o = _flash_bhsd(qt, kt, vt, scale, causal, sliding_window,
                     block_q, block_k)

@@ -1,32 +1,40 @@
 """Pipeline parallelism: microbatch rotation over the "pipe" mesh axis.
 
 TPU-native replacement for megatron/schedules.py (722 LoC) +
-megatron/p2p_communication.py (405 LoC). The reference hand-writes a 1F1B
-schedule with batched NCCL isend/irecv, output-tensor deallocation and a
-direct call into the C++ autograd engine (schedules.py:36-88). Here the
-schedule is a forward-only program:
+megatron/p2p_communication.py (405 LoC). The reference hand-writes 1F1B and
+interleaved schedules with batched NCCL isend/irecv, output-tensor
+deallocation and a direct call into the C++ autograd engine
+(schedules.py:36-88, :253-502, :606-722). Here the schedule is a
+forward-only program:
 
-  * the mesh "pipe" axis is manual (shard_map); each stage holds
-    layers[stage * Lp : (stage+1) * Lp] because the stacked layer params are
-    sharded over "pipe" on their leading axis,
+  * the mesh "pipe" axis is manual (shard_map); each stage holds its
+    layer parameters because the stacked layer params are sharded over
+    "pipe" on their leading axis,
   * microbatches rotate stage-to-stage with lax.ppermute
     (collective-permute rides ICI neighbors, like the reference's p2p ring),
   * the *backward* schedule is not written at all: jax.grad of ppermute is
     the reverse ppermute, so differentiating the forward loop yields the
     cooldown phase, with stage bodies rematerialized (jax.checkpoint) so
-    live activation memory is one [mbs, S, H] buffer per in-flight
-    microbatch, the same bound the reference gets from 1F1B + recompute.
+    live activation memory per stage is the scan carries — one [mbs, S, H]
+    residual per tick — matching the reference's 1F1B-with-recompute bound.
   * other mesh axes (data/context/tensor) stay automatic: GSPMD keeps
     handling TP/SP/DP inside each stage body.
 
-Embedding runs on every stage but feeds only stage 0 (a cheap gather);
-logits + loss run under lax.cond so only the last stage pays for them
+Tokens (int32, tiny) — not embedded activations — flow into the manual
+region; stage 0 embeds each microbatch *at its tick* via a one-hot matmul
+(MXU-friendly and partitions cleanly when the table is vocab-sharded,
+where a sharded gather trips the partial-manual partitioner). Logits +
+loss run under lax.cond so only the last stage pays for them
 (ref: post_language_model_processing on the last stage, gpt_model.py:18).
 
-Schedule flavor is GPipe-with-remat rather than interleaved 1F1B; the
-warmup/steady/cooldown structure emerges from autodiff rather than being
-scheduled by hand. Virtual-pipeline interleaving (ref schedules.py:253-502)
-maps to sharding layers round-robin over "pipe" — not yet implemented.
+Interleaved (virtual-pipeline) schedule: with V chunks per stage, virtual
+stage k (layers [k*Lv, (k+1)*Lv)) is placed round-robin on physical stage
+k % Pn (ref schedules.py:253-502, get_model_chunk_id :307-313). The same
+ppermute ring carries both stage-to-stage and wrap-around (last stage
+chunk c -> stage 0 chunk c+1) hops; the bubble shrinks from Pn-1 full
+stages to Pn-1 chunks of Lv layers, the 1/V reduction the reference's
+interleaving buys. Requires num_microbatches % Pn == 0 (ref
+schedules.py:22-29).
 """
 
 from __future__ import annotations
@@ -35,11 +43,12 @@ from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from megatron_tpu.config import ModelConfig
 from megatron_tpu.models.language_model import (
-    _layer_dropout_rates, embed_tokens, lm_logits, _remat_policy,
+    _dropout, _layer_dropout_rates, lm_logits, _remat_policy,
 )
 from megatron_tpu.models.transformer import block_forward
 from megatron_tpu.ops.cross_entropy import cross_entropy_loss
@@ -47,17 +56,46 @@ from megatron_tpu.ops.normalization import norm_forward
 from megatron_tpu.ops.rotary import precompute_rope
 
 
-def _stage_fn(cfg: ModelConfig, layers_local: Any, x: jnp.ndarray,
-              rope, positions, dropout_key, stage: jnp.ndarray,
-              layers_per_stage: int, recompute: str,
+def _embed_onehot(cfg: ModelConfig, params: Dict[str, Any],
+                  tokens: jnp.ndarray,  # [mbs, S] int32
+                  dropout_key: Optional[jax.Array]) -> jnp.ndarray:
+    """Embedding as one-hot @ table: the gather-free formulation that the
+    SPMD partitioner splits cleanly over a vocab-sharded table (partial
+    sums + reduce), usable inside the pipe-manual region. Chunked over
+    tokens so the transient one-hot stays small."""
+    table = params["embed"]["tokens"]            # [V, H]
+    V = table.shape[0]
+    mbs, S = tokens.shape
+    flat = tokens.reshape(-1)
+    n = flat.shape[0]
+    chunk = next((c for c in (1024, 512, 256, 128) if n % c == 0), n)
+
+    def body(_, ids):
+        oh = jax.nn.one_hot(ids, V, dtype=table.dtype)
+        return None, jax.lax.dot_general(oh, table, (((1,), (0,)), ((), ())))
+
+    _, out = jax.lax.scan(body, None, flat.reshape(n // chunk, chunk))
+    x = out.reshape(mbs, S, table.shape[1])
+    if cfg.position_embedding_type == "absolute":
+        x = x + params["embed"]["pos"][:S][None, :, :].astype(x.dtype)
+    if cfg.hidden_dropout > 0 and dropout_key is not None:
+        x = _dropout(x, cfg.hidden_dropout, dropout_key)
+    return x
+
+
+def _stage_fn(cfg: ModelConfig, chunk_layers: Any, x: jnp.ndarray,
+              rope, positions, dropout_key, global_offset: jnp.ndarray,
+              layers_per_chunk: int, recompute: str,
               sharder=None) -> jnp.ndarray:
-    """Run this stage's contiguous slice of layers (lax.scan over Lp)."""
+    """Run one chunk's contiguous slice of layers (lax.scan over Lv).
+    global_offset = index of the chunk's first layer in the full network
+    (for per-layer LIMA dropout rates and dropout key folding)."""
     rates_all = _layer_dropout_rates(cfg)  # [L] per-global-layer rates
 
     def body(carry, scanned):
         x = carry
         lp, local_idx = scanned
-        global_idx = stage * layers_per_stage + local_idx
+        global_idx = global_offset + local_idx
         rate = rates_all[global_idx]
         key = (jax.random.fold_in(dropout_key, global_idx)
                if dropout_key is not None else None)
@@ -69,7 +107,7 @@ def _stage_fn(cfg: ModelConfig, layers_local: Any, x: jnp.ndarray,
     policy = _remat_policy(recompute)
     if policy is not None:
         body = jax.checkpoint(body, policy=policy, prevent_cse=False)
-    x, _ = jax.lax.scan(body, x, (layers_local, jnp.arange(layers_per_stage)))
+    x, _ = jax.lax.scan(body, x, (chunk_layers, jnp.arange(layers_per_chunk)))
     return x
 
 
@@ -80,20 +118,42 @@ def make_pipeline_loss_fn(
     num_microbatches: int,
     recompute: str = "selective",
     sharder=None,
+    num_virtual_chunks: int = 1,
 ):
-    """Returns loss_fn(params, batch, dropout_key) -> (mean_loss, ntokens).
+    """Returns loss_fn(params, batch, dropout_key) -> (mean_loss, aux).
 
     batch leaves are [GB, S] with GB = num_microbatches * per-microbatch
     rows; the pipeline consumes one microbatch per tick. Requires
-    num_layers % num_stages == 0.
+    num_layers % (num_stages * num_virtual_chunks) == 0, and — for the
+    interleaved schedule — num_microbatches % num_stages == 0.
     """
-    Pn, M = num_stages, num_microbatches
+    Pn, M, V = num_stages, num_microbatches, num_virtual_chunks
     L = model_cfg.num_layers
-    if L % Pn:
-        raise ValueError(f"num_layers={L} not divisible by pipeline stages {Pn}")
-    Lp = L // Pn
+    if L % (Pn * V):
+        raise ValueError(
+            f"num_layers={L} not divisible by stages*chunks {Pn}*{V}")
+    Lv = L // (Pn * V)
     if M < 1:
         raise ValueError("need at least one microbatch")
+    if V > 1 and M % Pn:
+        raise ValueError(
+            f"interleaved schedule needs num_microbatches % num_stages == 0 "
+            f"(got {M} % {Pn}; ref schedules.py:22-29)")
+
+    # Round-robin chunk placement: new leading order (stage, chunk-slot,
+    # layer-in-chunk) <- virtual stage k = c*Pn + s covers layers
+    # [k*Lv, (k+1)*Lv). Identity when V == 1.
+    # KNOWN COST (V > 1): the take runs inside the jitted step, so ~(V-1)/V
+    # of the layer weights cross the pipe axis every step (and the scatter
+    # transpose every backward). Storing layer params in placed order —
+    # with the inverse permutation applied at checkpoint/interop
+    # boundaries — would eliminate it; until then interleaving trades
+    # weight traffic for the 1/V bubble reduction.
+    place = np.zeros(L, np.int32)
+    for s in range(Pn):
+        for c in range(V):
+            for j in range(Lv):
+                place[(s * V + c) * Lv + j] = ((c * Pn + s) * Lv) + j
 
     def loss_fn(params: Dict[str, Any], batch: Dict[str, jnp.ndarray],
                 dropout_key: Optional[jax.Array] = None):
@@ -109,26 +169,6 @@ def make_pipeline_loss_fn(
         dropout_on = dropout_key is not None and (
             model_cfg.hidden_dropout > 0 or model_cfg.attention_dropout > 0)
 
-        # Embed OUTSIDE the pipe-manual region: the vocab-sharded embedding
-        # gather stays in plain GSPMD land (the partial-manual partitioner
-        # chokes on sharded gathers), and stages don't redundantly re-embed.
-        # Embedding dropout matches lm_forward's keying (fold 0xE0B), with a
-        # per-microbatch fold so masks differ across microbatches.
-        if dropout_on and model_cfg.hidden_dropout > 0:
-            embed_keys = jax.vmap(
-                lambda i: jax.random.fold_in(
-                    jax.random.fold_in(dropout_key, 0xE0B), i)
-            )(jnp.arange(M))
-            embedded = jax.vmap(
-                lambda t, ek: embed_tokens(model_cfg, params, t, None,
-                                           dropout_key=ek)
-            )(tokens, embed_keys).astype(model_cfg.dtype)  # [M, mbs, S, H]
-        else:
-            embedded = jax.vmap(
-                lambda t: embed_tokens(model_cfg, params, t, None,
-                                       dropout_key=None)
-            )(tokens).astype(model_cfg.dtype)  # [M, mbs, S, H]
-
         rope = None
         if model_cfg.position_embedding_type == "rotary":
             rope = precompute_rope(model_cfg.head_dim,
@@ -136,11 +176,15 @@ def make_pipeline_loss_fn(
                                    model_cfg.rope_theta,
                                    model_cfg.rope_scaling_factor)
 
-        T = M + Pn - 1  # pipeline ticks
+        T = M * V + Pn - 1  # pipeline ticks
 
         key_arg = dropout_key if dropout_on else jax.random.PRNGKey(0)
 
-        def pipelined(layers, other, embedded, labels, loss_mask, key):
+        layers = params["layers"]
+        if V > 1:
+            layers = jax.tree.map(lambda a: jnp.take(a, place, axis=0), layers)
+
+        def pipelined(layers, other, tokens, labels, loss_mask, key):
             params_local = dict(other, layers=layers)
             stage = jax.lax.axis_index("pipe")
             is_first = stage == 0
@@ -150,17 +194,36 @@ def make_pipeline_loss_fn(
 
             def tick(carry, t):
                 state, loss_sum, tok_sum = carry
-                feed_idx = jnp.minimum(t, M - 1)
-                emb = embedded[feed_idx]
-                x = jnp.where(is_first & (t < M), emb, state)
-                mb_idx = t - stage  # which microbatch this stage works on
-                key_t = (jax.random.fold_in(key, mb_idx) if dropout_on else None)
-                out = _stage_fn(model_cfg, params_local["layers"], x, rope,
-                                None, key_t, stage, Lp, recompute,
-                                sharder=sharder)
+                n = jnp.clip(t - stage, 0, M * V - 1)  # this stage's step
+                valid = (t >= stage) & (t - stage < M * V)
+                g = n // (Pn * V)
+                j = n % (Pn * V)
+                c = j // Pn                       # chunk slot on this stage
+                m = g * Pn + j % Pn               # microbatch index
 
-                # loss on the last stage once the first microbatch arrives
-                out_idx = jnp.maximum(t - (Pn - 1), 0)
+                def embed(state):
+                    ek = None
+                    if dropout_on and model_cfg.hidden_dropout > 0:
+                        ek = jax.random.fold_in(
+                            jax.random.fold_in(key, 0xE0B), m)
+                    toks = jax.lax.dynamic_index_in_dim(
+                        tokens, m, 0, keepdims=False)
+                    return _embed_onehot(model_cfg, params_local, toks,
+                                         ek).astype(model_cfg.dtype)
+
+                x = jax.lax.cond(is_first & (c == 0) & valid, embed,
+                                 lambda s: s, state)
+
+                chunk_layers = jax.tree.map(
+                    lambda a: jax.lax.dynamic_index_in_dim(
+                        a.reshape((V, Lv) + a.shape[1:]), c, 0,
+                        keepdims=False),
+                    params_local["layers"])
+                global_offset = (c * Pn + stage) * Lv
+                key_t = (jax.random.fold_in(key, m) if dropout_on else None)
+                out = _stage_fn(model_cfg, chunk_layers, x, rope,
+                                None, key_t, global_offset, Lv, recompute,
+                                sharder=sharder)
 
                 def with_loss(_):
                     h = norm_forward(model_cfg.normalization, out,
@@ -168,15 +231,19 @@ def make_pipeline_loss_fn(
                                      params_local["final_ln"].get("bias"),
                                      model_cfg.layernorm_epsilon)
                     logits = lm_logits(model_cfg, params_local, h)
-                    _, per_tok = cross_entropy_loss(logits, labels[out_idx])
-                    m = loss_mask[out_idx]
-                    return jnp.sum(per_tok * m), jnp.sum(m)
+                    lab = jax.lax.dynamic_index_in_dim(labels, m, 0,
+                                                       keepdims=False)
+                    lm = jax.lax.dynamic_index_in_dim(loss_mask, m, 0,
+                                                      keepdims=False)
+                    _, per_tok = cross_entropy_loss(logits, lab)
+                    return jnp.sum(per_tok * lm), jnp.sum(lm)
 
                 def without_loss(_):
                     return jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)
 
                 lsum, lcnt = jax.lax.cond(
-                    is_last & (t >= Pn - 1), with_loss, without_loss, operand=None)
+                    is_last & (c == V - 1) & valid, with_loss, without_loss,
+                    operand=None)
 
                 state = jax.lax.ppermute(out, "pipe", perm)
                 return (state, loss_sum + lsum, tok_sum + lcnt), None
@@ -194,7 +261,7 @@ def make_pipeline_loss_fn(
 
         other = {k: v for k, v in params.items() if k != "layers"}
         in_specs = (
-            jax.tree.map(lambda _: P("pipe"), params["layers"]),
+            jax.tree.map(lambda _: P("pipe"), layers),
             jax.tree.map(lambda _: P(), other),
             P(), P(), P(), P(),
         )
@@ -206,7 +273,7 @@ def make_pipeline_loss_fn(
             axis_names={"pipe"},
             check_vma=False,
         )
-        mean_loss, ntokens = fn(params["layers"], other, embedded, labels,
+        mean_loss, ntokens = fn(layers, other, tokens, labels,
                                 loss_mask, key_arg)
         return mean_loss, {"lm_loss": mean_loss, "ntokens": ntokens}
 

@@ -81,7 +81,13 @@ class TrainLoop:
         self,
         run_cfg: RunConfig,
         log: Callable[[str], None] = print,
+        init_params_fn: Optional[Callable] = None,
+        param_specs_fn: Optional[Callable] = None,
     ):
+        """init_params_fn(model_cfg, key) / param_specs_fn(model_cfg) let
+        task entry points with their own parameter trees (T5's separate
+        encoder/decoder stacks) reuse the loop; default is the GPT-family
+        language model."""
         run_cfg.validate()
         self.cfg = run_cfg
         self.log = log
@@ -89,8 +95,8 @@ class TrainLoop:
         self.timers = Timers(run_cfg.training.timing_log_level)
 
         model_cfg = run_cfg.model
-        self.specs = param_specs(model_cfg)
-        params = init_params(model_cfg, jax.random.fold_in(
+        self.specs = (param_specs_fn or param_specs)(model_cfg)
+        params = (init_params_fn or init_params)(model_cfg, jax.random.fold_in(
             jax.random.PRNGKey(run_cfg.training.seed), 0))
         params = shard_tree(self.rt, params, self.specs)
         self.state = init_train_state(
@@ -170,7 +176,9 @@ class TrainLoop:
                 pp_loss_fn = make_pipeline_loss_fn(
                     self.cfg.model, self.rt.mesh, pp, num_microbatches,
                     recompute=self.cfg.training.recompute_granularity,
-                    sharder=self._sharder)
+                    sharder=self._sharder,
+                    num_virtual_chunks=(
+                        self.cfg.parallel.virtual_pipeline_parallel or 1))
             step = make_train_step(
                 self.cfg.model, self.cfg.optimizer, self.cfg.training,
                 num_microbatches=num_microbatches,
@@ -196,7 +204,7 @@ class TrainLoop:
         return {k: put(np.asarray(v)) for k, v in batch.items()}
 
     def train_step(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
-        gbs = batch["tokens"].shape[0]
+        gbs = next(iter(batch.values())).shape[0]
         n_micro = gbs // (self.cfg.training.micro_batch_size * self.rt.dp)
         step = self._train_step_for(max(n_micro, 1))
         with jax.sharding.set_mesh(self.rt.mesh):
@@ -272,7 +280,8 @@ class TrainLoop:
                 loss_host = float(metrics["loss"])  # host sync
                 self.timers("step", 0).stop()
 
-                ntok = batch["tokens"].size
+                ntok = batch.get("tokens",
+                                 next(iter(batch.values()))).size
                 window_tokens += ntok
                 loss_avg += loss_host
                 loss_n += 1

@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""T5 pretraining entry point (ref: pretrain_t5.py, 171 LoC).
+
+Data: a sentence-level indexed dataset (produce with
+tools/preprocess_data.py --split_sentences); samples are span-corrupted
+T5-style with sentinel tokens from the top of the vocabulary (the
+reference's --vocab_extra_ids 100 reserves tokenizer extra ids;
+here --vocab_extra_ids carves the same count from the top of vocab_size
+unless explicit sentinel ids are given).
+
+  python pretrain_t5.py --num_layers 12 --hidden_size 768 \
+      --num_attention_heads 12 --seq_length 512 --decoder_seq_length 128 \
+      --vocab_size 30592 --vocab_extra_ids 100 --data_path data/sents \
+      --train_iters 10000 ...
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from megatron_tpu.platform import ensure_platform
+
+ensure_platform()
+
+from megatron_tpu.arguments import args_to_run_config, parse_args
+
+
+def extra_args(p):
+    g = p.add_argument_group("t5")
+    g.add_argument("--decoder_seq_length", type=int, default=128)
+    g.add_argument("--vocab_extra_ids", type=int, default=100)
+    g.add_argument("--bos_token_id", type=int, default=101)
+    g.add_argument("--eos_token_id", type=int, default=102)
+    g.add_argument("--pad_token_id", type=int, default=0)
+    g.add_argument("--masked_lm_prob", type=float, default=0.15)
+    g.add_argument("--short_seq_prob", type=float, default=0.1)
+    return p
+
+
+def main(argv=None):
+    import dataclasses
+
+    from megatron_tpu.data.indexed_dataset import make_dataset
+    from megatron_tpu.data.samplers import PretrainingSampler, build_data_loader
+    from megatron_tpu.data.t5_dataset import T5Dataset
+    from megatron_tpu.models.t5 import (
+        t5_config, t5_init_params, t5_loss, t5_param_specs,
+    )
+    from megatron_tpu.training.pretrain import TrainLoop
+
+    args = parse_args(argv, extra_args_provider=extra_args)
+    cfg = args_to_run_config(args)
+    model = t5_config(
+        num_layers=cfg.model.num_layers,
+        hidden_size=cfg.model.hidden_size,
+        num_attention_heads=cfg.model.num_attention_heads,
+        vocab_size=cfg.model.vocab_size,
+        seq_length=cfg.model.seq_length,
+        decoder_seq_length=args.decoder_seq_length,
+        params_dtype=cfg.model.params_dtype,
+    )
+    cfg = dataclasses.replace(cfg, model=model)
+    if not args.data_path:
+        raise SystemExit("--data_path is required")
+
+    # sentinels from the top of the padded vocab (ref: tokenizer
+    # additional_special_tokens via --vocab_extra_ids)
+    v = cfg.model.vocab_size
+    sentinels = list(range(v - args.vocab_extra_ids, v))
+
+    t = cfg.training
+    indexed = make_dataset(args.data_path[0])
+    n_train = (t.train_iters or 1000) * t.global_batch_size
+    train_ds = T5Dataset(
+        indexed, num_samples=n_train,
+        max_seq_length=cfg.model.seq_length,
+        max_seq_length_dec=args.decoder_seq_length,
+        bos_token=args.bos_token_id, eos_token=args.eos_token_id,
+        pad_token=args.pad_token_id, sentinel_tokens=sentinels,
+        seed=t.seed, masked_lm_prob=args.masked_lm_prob,
+        short_seq_prob=args.short_seq_prob)
+
+    def train_iter_factory(consumed, gbs):
+        sampler = PretrainingSampler(len(train_ds), consumed, gbs, 0, 1)
+        return build_data_loader(train_ds, sampler)
+
+    loop = TrainLoop(cfg, init_params_fn=t5_init_params,
+                     param_specs_fn=t5_param_specs)
+
+    from megatron_tpu.training.train_step import make_train_step
+
+    def t5_loss_fn(model_cfg, p, b, key):
+        return t5_loss(model_cfg, p, b)
+
+    def step_for(n_micro):
+        if n_micro not in loop._step_cache:
+            import jax
+
+            step = make_train_step(cfg.model, cfg.optimizer, t,
+                                   num_microbatches=n_micro,
+                                   train_iters=t.train_iters,
+                                   sharder=loop._sharder,
+                                   loss_fn=t5_loss_fn)
+            loop._step_cache[n_micro] = jax.jit(
+                step, in_shardings=(loop.state_shardings, None),
+                donate_argnums=(0,))
+        return loop._step_cache[n_micro]
+
+    loop._train_step_for = step_for
+    loop.eval_loss_fn = lambda mc, p, b: t5_loss(mc, p, b)
+    loop.train(train_iter_factory)
+
+
+if __name__ == "__main__":
+    main()

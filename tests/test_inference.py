@@ -169,3 +169,60 @@ def test_tokenize_prompts_padding():
     assert batch.shape == (2, 3)
     np.testing.assert_array_equal(lengths, [3, 1])
     assert batch[1, 1] == tok.pad
+
+
+def test_beam_search_kv_cache_matches_full_reforward():
+    """The cached incremental beam decode must produce the same beams as a
+    brute-force full-re-forward implementation (the pre-KV-cache behavior)."""
+    from megatron_tpu.models.language_model import lm_forward
+
+    prompt = np.asarray([5, 11, 3], np.int32)
+    beam_size, new = 3, 6
+    eod = 63
+    got_beams, got_scores = beam_search_tokens(
+        CFG, PARAMS, prompt, max_new_tokens=new, beam_size=beam_size, eod=eod)
+
+    # reference: identical selection logic, logits from a full forward
+    plen, total = len(prompt), len(prompt) + new
+    beams = np.tile(prompt[None, :], (beam_size, 1))
+    scores = np.full((beam_size,), -1e9, np.float64)
+    scores[0] = 0.0
+    finished = []
+    for t in range(plen, total):
+        logits = np.asarray(
+            lm_forward(CFG, PARAMS, jnp.asarray(beams))[:, -1], np.float64)
+        logprobs = (logits
+                    - np.log(np.exp(logits - logits.max(-1, keepdims=True))
+                             .sum(-1, keepdims=True))
+                    - logits.max(-1, keepdims=True))
+        cand = (scores[:, None] + logprobs).reshape(-1)
+        top = np.argpartition(-cand, 2 * beam_size)[: 2 * beam_size]
+        top = top[np.argsort(-cand[top])]
+        nb, ns = [], []
+        for idx in top:
+            b, v = divmod(int(idx), logits.shape[-1])
+            seq = np.concatenate([beams[b], [v]])
+            if v == eod:
+                finished.append((cand[idx] / ((len(seq) - plen) ** 1.0), seq))
+            else:
+                nb.append(seq)
+                ns.append(cand[idx])
+            if len(nb) == beam_size:
+                break
+        beams = np.stack(nb)
+        scores = np.asarray(ns)
+        if len(finished) >= beam_size:
+            best_possible = scores.max() / max(1, t + 1 - plen)
+            worst_kept = sorted(finished, key=lambda x: -x[0])[beam_size - 1][0]
+            if worst_kept >= best_possible:
+                break
+    for s, b in zip(scores, beams):
+        finished.append((s / max(1, beams.shape[1] - plen),
+                         np.concatenate([b, [eod]])))
+    finished.sort(key=lambda x: -x[0])
+    want = np.stack([np.pad(f[1], (0, total + 1 - len(f[1])),
+                            constant_values=eod) for f in finished[:beam_size]])
+
+    np.testing.assert_array_equal(got_beams, want)
+    np.testing.assert_allclose(got_scores,
+                               [f[0] for f in finished[:beam_size]], rtol=1e-4)

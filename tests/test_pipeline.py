@@ -85,3 +85,39 @@ def test_pipeline_rejects_indivisible_layers():
     cfg, rt, params, batch = _setup(2, num_layers=4)
     with pytest.raises(ValueError):
         make_pipeline_loss_fn(cfg, rt.mesh, num_stages=3, num_microbatches=4)
+
+
+@pytest.mark.parametrize("pp,vpp", [(2, 2), (4, 2)])
+def test_interleaved_vpp_loss_matches_unpipelined(pp, vpp):
+    """Interleaved (virtual-pipeline) schedule parity: round-robin chunk
+    placement + the same ring must reproduce the unpipelined loss
+    (ref schedules.py:253-502)."""
+    cfg, rt, params, batch = _setup(pp, num_layers=pp * vpp, n_micro=pp)
+    pp_loss_fn = make_pipeline_loss_fn(cfg, rt.mesh, num_stages=pp,
+                                       num_microbatches=pp, recompute="full",
+                                       num_virtual_chunks=vpp)
+    with jax.sharding.set_mesh(rt.mesh):
+        loss_pp, aux = jax.jit(lambda p, b: pp_loss_fn(p, b, None))(params, batch)
+    loss_ref = lm_loss(cfg, jax.device_get(params), jax.device_get(batch))[0]
+    np.testing.assert_allclose(float(loss_pp), float(loss_ref), rtol=1e-5)
+    assert float(aux["ntokens"]) == batch["tokens"].size
+
+
+def test_interleaved_vpp_grads_match_unpipelined():
+    cfg, rt, params, batch = _setup(2, num_layers=4, n_micro=4)
+    pp_loss_fn = make_pipeline_loss_fn(cfg, rt.mesh, num_stages=2,
+                                       num_microbatches=4, recompute="full",
+                                       num_virtual_chunks=2)
+    with jax.sharding.set_mesh(rt.mesh):
+        g_pp = jax.jit(jax.grad(lambda p: pp_loss_fn(p, batch, None)[0]))(params)
+    g_ref = jax.grad(lambda p: lm_loss(cfg, p, batch)[0])(jax.device_get(params))
+    for a, b in zip(jax.tree.leaves(jax.device_get(g_pp)), jax.tree.leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=1e-5)
+
+
+def test_interleaved_vpp_microbatch_constraint():
+    cfg, rt, params, batch = _setup(2, num_layers=4, n_micro=4)
+    with pytest.raises(ValueError, match="num_microbatches"):
+        make_pipeline_loss_fn(cfg, rt.mesh, num_stages=2, num_microbatches=3,
+                              recompute="full", num_virtual_chunks=2)
