@@ -39,10 +39,21 @@ def _init_caches(cfg: ModelConfig, batch: int, total_len: int):
     return (jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
 
 
+def _default_fwd(cfg):
+    """forward_fn contract: (params, tokens, positions, caches,
+    cache_index) -> (logits, caches). Default = the single-stage cached
+    lm_forward; pipelined.make_pipelined_lm_forward provides the pp>1
+    version (ref forward_step.py:45-204)."""
+    def fwd(params, toks, positions, caches, cache_index):
+        return lm_forward(cfg, params, toks, positions=positions,
+                          kv_caches=caches, cache_index=cache_index)
+    return fwd
+
+
 @partial(jax.jit, static_argnames=("cfg", "total_len", "prefill_len",
                                    "temperature", "top_k",
                                    "top_p", "vocab_size", "eod",
-                                   "want_logprobs"))
+                                   "want_logprobs", "forward_fn"))
 def _generate_jit(
     cfg: ModelConfig,
     params: Any,
@@ -57,7 +68,9 @@ def _generate_jit(
     vocab_size: Optional[int],
     eod: Optional[int],
     want_logprobs: bool = True,
+    forward_fn=None,
 ):
+    fwd = forward_fn or _default_fwd(cfg)
     B = tokens.shape[0]
     min_len = jnp.min(lengths)
     caches = _init_caches(cfg, B, total_len)
@@ -69,10 +82,8 @@ def _generate_jit(
     # not pay a 2000-position prefill); decode overwrites cache entries for
     # positions it re-runs, with identical forced-token values.
     positions = jnp.arange(total_len)[None, :]
-    logits_all, caches = lm_forward(
-        cfg, params, tokens[:, :prefill_len],
-        positions=positions[:, :prefill_len],
-        kv_caches=caches, cache_index=0)
+    logits_all, caches = fwd(params, tokens[:, :prefill_len],
+                             positions[:, :prefill_len], caches, 0)
 
     # the full-prefill fp32 log_softmax ([B, S, V]) is only paid when the
     # caller wants per-token logprobs
@@ -99,9 +110,7 @@ def _generate_jit(
         if eod is not None:
             done = done | ((nxt == eod) & ~in_prompt)
         step_pos = jax.lax.dynamic_slice_in_dim(positions, t, 1, axis=1)
-        logits_step, caches = lm_forward(
-            cfg, params, nxt[:, None], positions=step_pos,
-            kv_caches=caches, cache_index=t)
+        logits_step, caches = fwd(params, nxt[:, None], step_pos, caches, t)
         return (t + 1, tokens, caches, done, key, lp, logits_step)
 
     def cond2(carry):
@@ -152,6 +161,7 @@ def generate_tokens(
     eod: Optional[int] = None,
     seed: int = 0,
     want_logprobs: bool = True,
+    forward_fn=None,
 ) -> GenerationOutput:
     B, max_prompt = prompts.shape
     total_len = max_prompt + max_new_tokens
@@ -169,7 +179,8 @@ def generate_tokens(
     toks, ends, lp = _generate_jit(
         cfg, params, jnp.asarray(tokens), jnp.asarray(lengths, jnp.int32),
         jax.random.PRNGKey(seed), total_len, prefill_len, float(temperature),
-        int(top_k), float(top_p), vocab_size, eod, want_logprobs)
+        int(top_k), float(top_p), vocab_size, eod, want_logprobs,
+        forward_fn)
     return GenerationOutput(tokens=np.asarray(toks), lengths=np.asarray(ends),
                             logprobs=np.asarray(lp))
 

@@ -1,0 +1,119 @@
+"""Pipelined inference forward for pp > 1.
+
+Equivalent of the reference's pipelined ForwardStep
+(megatron/text_generation/forward_step.py:45-204): there, each decode step
+streams (micro)batches through pipeline stages with NCCL p2p and the last
+stage broadcasts logits back. Here the layer stack runs under shard_map
+manual over the "pipe" axis — the stacked layer params and KV caches are
+sharded over their leading (layer) axis, the hidden state rotates
+stage-to-stage with lax.ppermute, and a final psum broadcasts the
+last stage's logits to every stage (the reference's
+broadcast_from_last_pipeline_stage, text_generation/communication.py).
+
+Each stage computes only at its own tick (lax.cond), so one forward costs
+Pn sequential stage-times — the unavoidable pipeline latency for a single
+batch — and each stage's KV caches stay resident on its devices.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from megatron_tpu.config import ModelConfig
+from megatron_tpu.models.language_model import lm_logits
+from megatron_tpu.models.transformer import block_forward
+from megatron_tpu.ops.normalization import norm_forward
+from megatron_tpu.ops.rotary import precompute_rope
+from megatron_tpu.training.pipeline import _embed_onehot
+
+
+def make_pipelined_lm_forward(cfg: ModelConfig, mesh: Mesh, num_stages: int):
+    """Returns fwd(params, tokens, positions, caches, cache_index) ->
+    (logits, caches) with the same contract as the lm_forward cached path
+    (language_model.py), usable as generation's forward_fn."""
+    Pn = num_stages
+    L = cfg.num_layers
+    if L % Pn:
+        raise ValueError(f"num_layers={L} not divisible by stages {Pn}")
+    Lp = L // Pn
+    perm = [(i, (i + 1) % Pn) for i in range(Pn)]
+
+    def pipelined(layers, other, tokens, positions, ck, cv, cache_index):
+        params_local = dict(other, layers=layers)
+        stage = jax.lax.axis_index("pipe")
+        B, S = tokens.shape
+        total = ck.shape[2]
+
+        rope = None
+        if cfg.position_embedding_type == "rotary":
+            rope = precompute_rope(cfg.head_dim, max(cfg.seq_length, total),
+                                   cfg.rope_theta, cfg.rope_scaling_factor)
+
+        x0 = _embed_onehot(cfg, params_local, tokens, None,
+                           positions=positions).astype(cfg.dtype)
+
+        def tick(carry, t):
+            state, ck, cv, logits = carry
+            active = t == stage
+
+            def compute(args):
+                state, ck, cv = args
+                x = jnp.where(stage == 0, x0, state)
+
+                def lbody(x, sc):
+                    lp, k1, v1 = sc
+                    y, new_kv = block_forward(
+                        cfg, lp, x, rope, positions,
+                        kv_cache=(k1, v1), cache_index=cache_index)
+                    return y, new_kv
+
+                y, (nk, nv) = jax.lax.scan(lbody, x, (layers, ck, cv))
+                return y, nk, nv
+
+            state2, ck2, cv2 = jax.lax.cond(
+                active, compute, lambda a: a, (state, ck, cv))
+
+            def mk_logits(_):
+                h = norm_forward(cfg.normalization, state2,
+                                 params_local["final_ln"]["scale"],
+                                 params_local["final_ln"].get("bias"),
+                                 cfg.layernorm_epsilon)
+                return lm_logits(cfg, params_local, h).astype(jnp.float32)
+
+            logits = jax.lax.cond(active & (stage == Pn - 1), mk_logits,
+                                  lambda _: logits, None)
+            state3 = jax.lax.ppermute(state2, "pipe", perm)
+            return (state3, ck2, cv2, logits), None
+
+        V = (cfg.vocab_size if not cfg.tie_embed_logits
+             else params_local["embed"]["tokens"].shape[0])
+        init = (jnp.zeros((B, S, cfg.hidden_size), cfg.dtype), ck, cv,
+                jnp.zeros((B, S, V), jnp.float32))
+        (state, ck, cv, logits), _ = jax.lax.scan(tick, init, jnp.arange(Pn))
+        # zeros everywhere but the last stage: psum = broadcast
+        logits = jax.lax.psum(logits, "pipe")
+        return logits, ck, cv
+
+    def fwd(params, tokens, positions, caches, cache_index):
+        layers = params["layers"]
+        other = {k: v for k, v in params.items() if k != "layers"}
+        fn = jax.shard_map(
+            pipelined,
+            mesh=mesh,
+            in_specs=(jax.tree.map(lambda _: P("pipe"), layers),
+                      jax.tree.map(lambda _: P(), other),
+                      P(), P(), P("pipe"), P("pipe"), P()),
+            out_specs=(P(), P("pipe"), P("pipe")),
+            axis_names={"pipe"},
+            check_vma=False,
+        )
+        logits, ck, cv = fn(layers, other, tokens, positions,
+                            caches[0], caches[1], cache_index)
+        return logits, (ck, cv)
+
+    return fwd

@@ -1,0 +1,132 @@
+"""Sequence classification and multiple-choice heads over the BERT encoder.
+
+Equivalent of megatron/model/classification.py (107 LoC) and
+multiple_choice.py (120 LoC): both run the padded bidirectional encoder
+with a pooler (tanh of the [CLS] hidden state, ref language_model Pooler),
+dropout, and a single linear head — [H, num_classes] for classification,
+[H, 1] scored per choice for multiple choice (options flattened into the
+batch dim, multiple_choice.py:57-96).
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from megatron_tpu.config import ModelConfig
+from megatron_tpu.models.bert import bert_config
+from megatron_tpu.models.language_model import lm_forward
+from megatron_tpu.models.params import init_params, param_specs
+from megatron_tpu.models.transformer import Sharder, _dropout, _identity_sharder
+from megatron_tpu.ops.cross_entropy import cross_entropy_loss
+
+
+def classification_config(**kw) -> ModelConfig:
+    """BERT-shaped encoder; the binary-head flag brings the pooler params
+    (ref: get_language_model(add_pooler=True), classification.py:33-42)."""
+    return bert_config(**kw)
+
+
+def cls_init_params(cfg: ModelConfig, key: jax.Array,
+                    num_classes: int) -> Dict[str, Any]:
+    """Encoder params + a fresh classification head [H, num_classes]."""
+    params = init_params(cfg, key)
+    k = jax.random.fold_in(key, zlib.crc32(b"classification_head") & 0x7FFFFFFF)
+    params["classification_head"] = {
+        "w": (jax.random.normal(k, (cfg.hidden_size, num_classes), jnp.float32)
+              * cfg.init_method_std).astype(cfg.dtype),
+        "b": jnp.zeros((num_classes,), cfg.dtype),
+    }
+    return params
+
+
+def cls_param_specs(cfg: ModelConfig) -> Dict[str, Any]:
+    specs = param_specs(cfg)
+    specs["classification_head"] = {"w": P(), "b": P()}
+    return specs
+
+
+def _pooled(cfg, params, tokens, padding_mask, tokentype_ids, dropout_key,
+            sharder):
+    hidden = lm_forward(cfg, params, tokens, dropout_key=dropout_key,
+                        sharder=sharder, return_hidden=True,
+                        attention_mask=padding_mask,
+                        tokentype_ids=tokentype_ids)
+    pooled = jnp.tanh(
+        jnp.einsum("bh,hk->bk", hidden[:, 0], params["pooler"]["w"])
+        + params["pooler"]["b"])
+    if cfg.hidden_dropout > 0 and dropout_key is not None:
+        pooled = _dropout(pooled, cfg.hidden_dropout,
+                          jax.random.fold_in(dropout_key, 0xC1A55))
+    return pooled
+
+
+def classification_forward(
+    cfg: ModelConfig,
+    params: Dict[str, Any],
+    tokens: jnp.ndarray,            # [B, S]
+    padding_mask: jnp.ndarray,      # [B, S] True = real token
+    tokentype_ids: Optional[jnp.ndarray] = None,
+    dropout_key: Optional[jax.Array] = None,
+    sharder: Sharder = _identity_sharder,
+) -> jnp.ndarray:
+    """[B, num_classes] logits."""
+    pooled = _pooled(cfg, params, tokens, padding_mask, tokentype_ids,
+                     dropout_key, sharder)
+    head = params["classification_head"]
+    return pooled @ head["w"] + head["b"]
+
+
+def multichoice_forward(
+    cfg: ModelConfig,
+    params: Dict[str, Any],
+    tokens: jnp.ndarray,            # [B, C, S]
+    padding_mask: jnp.ndarray,      # [B, C, S]
+    tokentype_ids: Optional[jnp.ndarray] = None,
+    dropout_key: Optional[jax.Array] = None,
+    sharder: Sharder = _identity_sharder,
+) -> jnp.ndarray:
+    """[B, C] per-choice scores (head is [H, 1]; num_classes=1 config,
+    ref multiple_choice.py:46-50)."""
+    b, c, s = tokens.shape
+    flat = lambda x: (x.reshape(b * c, s) if x is not None else None)
+    pooled = _pooled(cfg, params, flat(tokens), flat(padding_mask),
+                     flat(tokentype_ids), dropout_key, sharder)
+    head = params["classification_head"]
+    scores = pooled @ head["w"] + head["b"]   # [B*C, 1]
+    return scores.reshape(b, c)
+
+
+def classification_logits(
+    cfg: ModelConfig,
+    params: Dict[str, Any],
+    batch: Dict[str, jnp.ndarray],
+    dropout_key: Optional[jax.Array] = None,
+    sharder: Sharder = _identity_sharder,
+) -> jnp.ndarray:
+    """Dispatch on batch shape: rank-3 tokens = multiple choice."""
+    if batch["tokens"].ndim == 3:
+        return multichoice_forward(
+            cfg, params, batch["tokens"], batch["padding_mask"] > 0,
+            batch.get("tokentype_ids"), dropout_key, sharder)
+    return classification_forward(
+        cfg, params, batch["tokens"], batch["padding_mask"] > 0,
+        batch.get("tokentype_ids"), dropout_key, sharder)
+
+
+def classification_loss(
+    cfg: ModelConfig,
+    params: Dict[str, Any],
+    batch: Dict[str, jnp.ndarray],
+    dropout_key: Optional[jax.Array] = None,
+    sharder: Sharder = _identity_sharder,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """batch: tokens, padding_mask, tokentype_ids, label."""
+    logits = classification_logits(cfg, params, batch, dropout_key, sharder)
+    loss, _ = cross_entropy_loss(logits[:, None, :], batch["label"][:, None])
+    acc = jnp.mean((jnp.argmax(logits, -1) == batch["label"]).astype(jnp.float32))
+    return loss, {"loss": loss, "accuracy": acc}

@@ -45,7 +45,10 @@ def _dropout(x: jnp.ndarray, rate, key: Optional[jax.Array]) -> jnp.ndarray:
     if key is None:
         return x
     keep = jax.random.bernoulli(key, 1.0 - rate, x.shape)
-    return jnp.where(keep, x / (1.0 - rate), jnp.zeros_like(x))
+    # rate may be a traced fp32 scalar (LIMA per-layer ramp): keep the
+    # rescale in x's dtype or bf16 activations silently promote to fp32
+    inv = jnp.asarray(1.0 / (1.0 - rate), x.dtype)
+    return jnp.where(keep, x * inv, jnp.zeros_like(x))
 
 
 def attention_block(

@@ -58,11 +58,16 @@ from megatron_tpu.ops.rotary import precompute_rope
 
 def _embed_onehot(cfg: ModelConfig, params: Dict[str, Any],
                   tokens: jnp.ndarray,  # [mbs, S] int32
-                  dropout_key: Optional[jax.Array]) -> jnp.ndarray:
+                  dropout_key: Optional[jax.Array],
+                  positions: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Embedding as one-hot @ table: the gather-free formulation that the
     SPMD partitioner splits cleanly over a vocab-sharded table (partial
     sums + reduce), usable inside the pipe-manual region. Chunked over
-    tokens so the transient one-hot stays small."""
+    tokens so the transient one-hot stays small.
+
+    positions: absolute positions [mbs, S] (decode steps); defaults to
+    [0, S) — the position table is replicated, so a plain gather is fine
+    for it (only the vocab-sharded token table needs the one-hot form)."""
     table = params["embed"]["tokens"]            # [V, H]
     V = table.shape[0]
     mbs, S = tokens.shape
@@ -77,7 +82,12 @@ def _embed_onehot(cfg: ModelConfig, params: Dict[str, Any],
     _, out = jax.lax.scan(body, None, flat.reshape(n // chunk, chunk))
     x = out.reshape(mbs, S, table.shape[1])
     if cfg.position_embedding_type == "absolute":
-        x = x + params["embed"]["pos"][:S][None, :, :].astype(x.dtype)
+        pos_table = params["embed"]["pos"]
+        if positions is None:
+            pos = pos_table[:S][None, :, :]
+        else:
+            pos = jnp.take(pos_table, positions, axis=0)
+        x = x + pos.astype(x.dtype)
     if cfg.hidden_dropout > 0 and dropout_key is not None:
         x = _dropout(x, cfg.hidden_dropout, dropout_key)
     return x

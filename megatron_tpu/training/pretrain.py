@@ -91,7 +91,13 @@ class TrainLoop:
         run_cfg.validate()
         self.cfg = run_cfg
         self.log = log
-        self.rt: MeshRuntime = build_mesh(run_cfg.parallel)
+        if jax.process_count() > 1:
+            # multi-host: DCN-aware mesh (data axis outermost across slices)
+            from megatron_tpu.parallel.distributed import build_multihost_mesh
+
+            self.rt: MeshRuntime = build_multihost_mesh(run_cfg.parallel)
+        else:
+            self.rt = build_mesh(run_cfg.parallel)
         self.timers = Timers(run_cfg.training.timing_log_level)
 
         model_cfg = run_cfg.model
@@ -194,11 +200,22 @@ class TrainLoop:
         return self._step_cache[num_microbatches]
 
     def _put_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, jnp.ndarray]:
+        multihost = jax.process_count() > 1
+        if multihost:
+            from megatron_tpu.parallel.distributed import host_batch_slice
+
+            rows = next(iter(batch.values())).shape[0]
+            lo, hi = host_batch_slice(self.rt, rows)
+
         def put(v):
             if v.ndim == 1:  # per-sample scalars (e.g. BERT is_random)
                 sh = NamedSharding(self.rt.mesh, P("data"))
             else:
                 sh = self.batch_sharding
+            if multihost:
+                # each process contributes only its addressable rows
+                return jax.make_array_from_process_local_data(
+                    sh, np.asarray(v[lo:hi]), v.shape)
             return jax.device_put(v, sh)
 
         return {k: put(np.asarray(v)) for k, v in batch.items()}

@@ -20,6 +20,10 @@ from megatron_tpu.platform import ensure_platform
 
 ensure_platform()
 
+from megatron_tpu.parallel.distributed import initialize_distributed
+
+initialize_distributed()
+
 from megatron_tpu.arguments import args_to_run_config, parse_args
 
 

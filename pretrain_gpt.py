@@ -21,6 +21,10 @@ from megatron_tpu.platform import ensure_platform
 
 ensure_platform()
 
+from megatron_tpu.parallel.distributed import initialize_distributed
+
+initialize_distributed()
+
 from megatron_tpu.arguments import args_to_run_config, parse_args
 from megatron_tpu.data.gpt_dataset import build_gpt_datasets
 from megatron_tpu.data.samplers import PretrainingSampler, build_data_loader

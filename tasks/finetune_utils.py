@@ -1,0 +1,120 @@
+"""Shared finetune loop for classification tasks (ref:
+tasks/finetune_utils.py + tasks/eval_utils.py): epoch-based training over
+an in-memory tokenized dataset with the classification loss, and
+accuracy evaluation at epoch ends."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+import numpy as np
+
+from megatron_tpu.models.classification import (
+    classification_loss, cls_init_params, cls_param_specs,
+)
+from megatron_tpu.training.pretrain import TrainLoop
+
+
+def _collate(items: List[Dict]) -> Dict[str, np.ndarray]:
+    return {k: np.stack([it[k] for it in items]) for k in items[0]}
+
+
+def _epoch_iter(ds, consumed: int, gbs: int, seed: int):
+    """Deterministic shuffled sample stream resumable at `consumed`
+    (the reference's MegatronPretrainingRandomSampler policy). Batches may
+    straddle epoch boundaries so no tail is ever dropped — position in the
+    epoch-concatenated permutation stream is exactly `consumed`, which
+    keeps resume exact and prevents the one-epoch stall when gbs does not
+    divide len(ds)."""
+    n = len(ds)
+    orders: dict = {}
+
+    def sample(pos):
+        e, o = divmod(pos, n)
+        if e not in orders:
+            orders[e] = np.random.RandomState(seed + e).permutation(n)
+        return ds[int(orders[e][o])]
+
+    pos = consumed
+    while True:
+        yield _collate([sample(pos + i) for i in range(gbs)])
+        pos += gbs
+
+
+def accuracy(loop: TrainLoop, ds, batch: int = 32) -> float:
+    """Argmax accuracy over the WHOLE dataset (ref:
+    eval_utils.accuracy_func_provider): tail batches are padded to the
+    batch size (keeps the per-batch shape and data-axis divisibility) and
+    only real rows are counted; the scoring fn is jitted once."""
+    import jax
+    import jax.numpy as jnp
+
+    from megatron_tpu.models.classification import classification_logits
+
+    model_cfg = loop.cfg.model
+
+    @jax.jit
+    def correct_vec(p, b):
+        logits = classification_logits(model_cfg, p, b)
+        return (jnp.argmax(logits, -1) == b["label"]).astype(jnp.float32)
+
+    correct, total = 0.0, 0
+    with jax.sharding.set_mesh(loop.rt.mesh):
+        for i in range(0, len(ds), batch):
+            rows = [ds[j] for j in range(i, min(i + batch, len(ds)))]
+            n_real = len(rows)
+            rows += [rows[0]] * (batch - n_real)  # pad tail, count real only
+            b = _collate(rows)
+            vec = np.asarray(correct_vec(loop.state.params, loop._put_batch(b)))
+            correct += float(vec[:n_real].sum())
+            total += n_real
+    return correct / max(total, 1)
+
+
+def finetune_classification(cfg, num_classes: int, train_ds, valid_ds,
+                            log: Callable[[str], None] = print) -> TrainLoop:
+    """Train with the classification loss; returns the loop (state inside).
+    cfg.training.train_iters must already reflect epochs * len / gbs."""
+    import functools
+    import jax
+
+    from megatron_tpu.training.train_step import make_train_step
+
+    loop = TrainLoop(
+        cfg, log=log,
+        init_params_fn=functools.partial(cls_init_params,
+                                         num_classes=num_classes),
+        param_specs_fn=cls_param_specs)
+
+    def loss_fn(model_cfg, p, b, key):
+        return classification_loss(model_cfg, p, b, dropout_key=key,
+                                   sharder=loop._sharder)
+
+    def step_for(n_micro):
+        if n_micro not in loop._step_cache:
+            step = make_train_step(cfg.model, cfg.optimizer, cfg.training,
+                                   num_microbatches=n_micro,
+                                   train_iters=cfg.training.train_iters,
+                                   sharder=loop._sharder, loss_fn=loss_fn)
+            loop._step_cache[n_micro] = jax.jit(
+                step, in_shardings=(loop.state_shardings, None),
+                donate_argnums=(0,))
+        return loop._step_cache[n_micro]
+
+    loop._train_step_for = step_for
+    loop.eval_loss_fn = lambda mc, p, b: classification_loss(
+        mc, p, b, sharder=loop._sharder)
+
+    seed = cfg.training.seed
+
+    def train_iter_factory(consumed, gbs):
+        return _epoch_iter(train_ds, consumed, gbs, seed)
+
+    def valid_iter_factory():
+        return _epoch_iter(valid_ds, 0, cfg.training.micro_batch_size
+                           * loop.rt.dp, seed)
+
+    loop.train(train_iter_factory, valid_iter_factory)
+    acc = accuracy(loop, valid_ds)
+    log(f"final validation accuracy: {acc:.4f}")
+    return loop

@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Task finetune/eval harness (ref: tasks/main.py, 96 LoC).
+
+  python -m tasks.main --task MNLI --train_data train.tsv \
+      --valid_data dev.tsv --epochs 3 --pretrained_checkpoint ckpt/ \
+      --num_layers 12 ... --tokenizer_type HF --tokenizer_model bert-base-...
+
+Tasks: MNLI, QQP (sentence-pair classification), RACE (multiple choice).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from megatron_tpu.platform import ensure_platform
+
+ensure_platform()
+
+from megatron_tpu.parallel.distributed import initialize_distributed
+
+initialize_distributed()
+
+from megatron_tpu.arguments import args_to_run_config, parse_args
+
+
+def extra_args(p):
+    g = p.add_argument_group("tasks")
+    g.add_argument("--task", required=True, choices=["MNLI", "QQP", "RACE"])
+    g.add_argument("--train_data", nargs="+", required=True)
+    g.add_argument("--valid_data", nargs="+", required=True)
+    g.add_argument("--epochs", type=int, default=3)
+    g.add_argument("--pretrained_checkpoint", type=str, default=None)
+    g.add_argument("--cls_token_id", type=int, default=101)
+    g.add_argument("--sep_token_id", type=int, default=102)
+    g.add_argument("--pad_token_id", type=int, default=0)
+    return p
+
+
+def main(argv=None):
+    import dataclasses
+
+    from megatron_tpu.models.classification import classification_config
+    from megatron_tpu.tokenizer.tokenizer import build_tokenizer
+    from tasks.finetune_utils import finetune_classification
+    from tasks.glue import GlueDataset, load_mnli, load_qqp
+    from tasks.race import RaceDataset, load_race
+
+    args = parse_args(argv, extra_args_provider=extra_args)
+    cfg = args_to_run_config(args)
+    model = classification_config(
+        num_layers=cfg.model.num_layers,
+        hidden_size=cfg.model.hidden_size,
+        num_attention_heads=cfg.model.num_attention_heads,
+        vocab_size=cfg.model.vocab_size,
+        seq_length=cfg.model.seq_length,
+        params_dtype=cfg.model.params_dtype,
+    )
+    cfg = dataclasses.replace(cfg, model=model)
+
+    tok = build_tokenizer(args.tokenizer_type, vocab_size=cfg.model.vocab_size,
+                          tokenizer_model=getattr(args, "tokenizer_model", None))
+    ids = dict(cls_id=args.cls_token_id, sep_id=args.sep_token_id,
+               pad_id=args.pad_token_id)
+
+    if args.task == "RACE":
+        num_classes = 1  # per-choice score head [H, 1] (ref multiple_choice.py:46)
+        train_raw = [s for p in args.train_data for s in load_race(p)]
+        valid_raw = [s for p in args.valid_data for s in load_race(p)]
+        train_ds = RaceDataset(train_raw, tok.tokenize, cfg.model.seq_length, **ids)
+        valid_ds = RaceDataset(valid_raw, tok.tokenize, cfg.model.seq_length, **ids)
+    else:
+        loader = load_mnli if args.task == "MNLI" else load_qqp
+        num_classes = 3 if args.task == "MNLI" else 2
+        train_raw = [s for p in args.train_data for s in loader(p)]
+        valid_raw = [s for p in args.valid_data for s in loader(p)]
+        train_ds = GlueDataset(train_raw, tok.tokenize, cfg.model.seq_length, **ids)
+        valid_ds = GlueDataset(valid_raw, tok.tokenize, cfg.model.seq_length, **ids)
+
+    t = cfg.training
+    iters = max(1, args.epochs * len(train_ds) // t.global_batch_size)
+    training = dataclasses.replace(
+        t, train_iters=iters,
+        load=args.pretrained_checkpoint or t.load,
+        finetune=bool(args.pretrained_checkpoint) or t.finetune)
+    cfg = dataclasses.replace(cfg, training=training)
+
+    print(f"{args.task}: {len(train_ds)} train / {len(valid_ds)} valid "
+          f"samples, {num_classes} classes, {iters} iterations")
+    finetune_classification(cfg, num_classes, train_ds, valid_ds)
+
+
+if __name__ == "__main__":
+    main()
