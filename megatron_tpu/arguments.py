@@ -47,6 +47,12 @@ def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
     g.add_argument("--tie_embed_logits", action="store_true")
     g.add_argument("--sliding_window_size", type=int, default=None)
     g.add_argument("--lima_dropout", action="store_true")
+    g.add_argument("--encoder_seq_length", type=int, default=None,
+                   help="alias of --seq_length (ref derives one from the other)")
+    g.add_argument("--attention_softmax_in_fp32", action="store_true",
+                   default=True,
+                   help="always on here (the TPU path computes softmax in "
+                        "fp32 by default); flag kept for CLI parity")
     g.add_argument("--model_name", default=None,
                    help="preset: llama/llama2/codellama/falcon/mistral/gpt2"
                         " (optionally 'name-SIZE', e.g. llama2-7B)")
@@ -73,9 +79,22 @@ def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
     g.add_argument("--init_method_std", type=float, default=0.02)
     g.add_argument("--recompute_granularity", default="none",
                    choices=["none", "selective", "full"])
+    g.add_argument("--recompute_activations", action="store_true",
+                   help="ref alias for --recompute_granularity selective")
+    g.add_argument("--recompute_method", default="uniform",
+                   choices=["uniform"],
+                   help="only 'uniform' (per-layer remat inside lax.scan); "
+                        "the ref's 'block' granularity has no XLA analogue")
     g.add_argument("--optimizer", default="adam", choices=["adam", "sgd"])
+    g.add_argument("--sgd_momentum", type=float, default=0.9)
     g.add_argument("--attention_impl", default="xla",
                    choices=["xla", "pallas", "ring"])
+    g.add_argument("--use_flash_attn", action="store_true",
+                   help="ref alias for --attention_impl pallas")
+    g.add_argument("--exit_signal_handler", action="store_true",
+                   default=True,
+                   help="SIGTERM checkpoint-and-exit is always enabled here")
+    g.add_argument("--eval_only", action="store_true")
 
     g = p.add_argument_group("learning rate")
     g.add_argument("--lr", type=float, default=3e-4)
@@ -86,6 +105,15 @@ def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
     g.add_argument("--lr_decay_iters", type=int, default=None)
     g.add_argument("--lr_warmup_iters", type=int, default=0)
     g.add_argument("--lr_warmup_fraction", type=float, default=None)
+    g.add_argument("--lr_decay_samples", type=int, default=None,
+                   help="converted to iters via global_batch_size")
+    g.add_argument("--lr_warmup_samples", type=int, default=None,
+                   help="converted to iters via global_batch_size")
+    g.add_argument("--override_opt_param_scheduler", action="store_true",
+                   default=True,
+                   help="always effectively on: schedules here are pure "
+                        "functions of (config, step), never checkpointed "
+                        "state, so CLI values always apply")
     g.add_argument("--adam_beta1", type=float, default=0.9)
     g.add_argument("--adam_beta2", type=float, default=0.999)
     g.add_argument("--adam_eps", type=float, default=1e-8)
@@ -98,6 +126,13 @@ def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
     g.add_argument("--finetune", action="store_true")
     g.add_argument("--no_load_optim", action="store_true")
     g.add_argument("--no_load_rng", action="store_true")
+    g.add_argument("--use_checkpoint_args", action="store_true",
+                   help="read model-architecture args from the checkpoint's "
+                        "saved config (ref load_args_from_checkpoint)")
+    g.add_argument("--no_initialization", action="store_true",
+                   default=True,
+                   help="accepted for parity; params are always initialized "
+                        "lazily/jitted here, there is no slow eager init to skip")
 
     g = p.add_argument_group("mixed precision")
     g.add_argument("--bf16", action="store_true")
@@ -113,8 +148,19 @@ def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
     g.add_argument("--tensor_model_parallel_size", type=int, default=1)
     g.add_argument("--pipeline_model_parallel_size", type=int, default=1)
     g.add_argument("--context_parallel_size", type=int, default=1)
+    g.add_argument("--num_layers_per_virtual_pipeline_stage", type=int,
+                   default=None,
+                   help="enables the interleaved schedule "
+                        "(ref schedules.py:253-502)")
     g.add_argument("--sequence_parallel", action="store_true")
     g.add_argument("--use_distributed_optimizer", action="store_true")
+    g.add_argument("--distributed_backend", default="xla",
+                   choices=["xla", "nccl", "gloo"],
+                   help="collectives are always XLA on this stack; "
+                        "nccl/gloo accepted for script compat and ignored")
+    g.add_argument("--local_rank", type=int, default=None,
+                   help="accepted for torchrun-script compat; process "
+                        "identity comes from jax.distributed here")
 
     g = p.add_argument_group("validation")
     g.add_argument("--eval_interval", type=int, default=1000)
@@ -124,20 +170,51 @@ def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
     g = p.add_argument_group("data")
     g.add_argument("--data_path", nargs="*", default=None)
     g.add_argument("--split", default="969,30,1")
+    g.add_argument("--data_impl", default="mmap", choices=["mmap", "infer"],
+                   help="only the mmap format exists here (the ref's "
+                        "lazy/cached impls are legacy)")
+    g.add_argument("--mmap_warmup", action="store_true",
+                   help="accepted for parity; the OS page cache handles it")
+    g.add_argument("--num_workers", type=int, default=2,
+                   help="accepted for parity; the loader is synchronous "
+                        "(host input is not the bottleneck on TPU)")
     g.add_argument("--tokenizer_type", default="SentencePieceTokenizer")
     g.add_argument("--vocab_file", default=None)
     g.add_argument("--merges_file", default=None)
+    g.add_argument("--merge_file", dest="merges_file", default=None,
+                   help="ref spelling of --merges_file")
     g.add_argument("--tokenizer_model", default=None)
+    g.add_argument("--vocab_extra_ids", type=int, default=None)
     g.add_argument("--data_cache_dir", default=None)
     g.add_argument("--scalar_loss_mask", type=float, default=0.0)
     g.add_argument("--variable_seq_lengths", action="store_true")
     g.add_argument("--eod_mask_loss", action="store_true")
+    g.add_argument("--eod_token_id", type=int, default=None,
+                   help="EOD id for --eod_mask_loss/--reset_position_ids "
+                        "when no tokenizer is built (the reference reads it "
+                        "from the tokenizer)")
+    g.add_argument("--reset_position_ids", action="store_true",
+                   help="restart position ids after each EOD")
+    g.add_argument("--reset_attention_mask", action="store_true",
+                   help="accepted with --reset_position_ids: EOD isolation "
+                        "is carried by packed position ids + causal masking "
+                        "(no materialized [S,S] mask on this stack)")
+    g.add_argument("--mask_prob", type=float, default=0.15)
+    g.add_argument("--short_seq_prob", type=float, default=0.1)
 
     g = p.add_argument_group("logging")
     g.add_argument("--log_interval", type=int, default=100)
     g.add_argument("--tensorboard_dir", default=None)
     g.add_argument("--wandb_logger", action="store_true")
+    g.add_argument("--wandb_project", default="megatron_tpu")
+    g.add_argument("--wandb_name", default=None)
     g.add_argument("--timing_log_level", type=int, default=0)
+    g.add_argument("--log_num_zeros_in_grad", action="store_true")
+    g.add_argument("--log_validation_ppl_to_tensorboard", action="store_true",
+                   default=True,
+                   help="validation ppl always goes to the writer here")
+    g.add_argument("--log_timers_to_tensorboard", action="store_true",
+                   help="ref alias: raises --timing_log_level to 1")
 
     if extra_args_provider is not None:
         extra_args_provider(p)
@@ -148,7 +225,37 @@ def args_to_run_config(args) -> RunConfig:
     from megatron_tpu.models import presets
     from megatron_tpu.tokenizer import pad_vocab_size
 
-    if args.model_name:
+    # reference aliases resolved up front
+    if getattr(args, "encoder_seq_length", None):
+        args.seq_length = args.encoder_seq_length
+    if getattr(args, "use_flash_attn", False):
+        args.attention_impl = "pallas"
+    if getattr(args, "recompute_activations", False) \
+            and args.recompute_granularity == "none":
+        args.recompute_granularity = "selective"
+    if getattr(args, "log_timers_to_tensorboard", False):
+        args.timing_log_level = max(args.timing_log_level, 1)
+    gbs = args.global_batch_size or args.micro_batch_size
+    if getattr(args, "lr_decay_samples", None) or getattr(
+            args, "lr_warmup_samples", None):
+        if args.rampup_batch_size:
+            raise ValueError(
+                "--lr_{decay,warmup}_samples are converted to iterations "
+                "via the final global batch size, which is wrong under "
+                "--rampup_batch_size; use --lr_{decay,warmup}_iters")
+        if args.lr_decay_samples and not args.lr_decay_iters:
+            args.lr_decay_iters = args.lr_decay_samples // gbs
+        if args.lr_warmup_samples and not args.lr_warmup_iters:
+            args.lr_warmup_iters = args.lr_warmup_samples // gbs
+
+    ckpt_model = None
+    if getattr(args, "use_checkpoint_args", False) and args.load:
+        ckpt_model = _model_config_from_checkpoint(
+            args.load, getattr(args, "load_iters", None))
+
+    if ckpt_model is not None:
+        model = ckpt_model
+    elif args.model_name:
         name = args.model_name
         size = args.model_size
         if "-" in name and size is None:
@@ -208,15 +315,27 @@ def args_to_run_config(args) -> RunConfig:
             attention_impl=args.attention_impl,
         ).validate()
 
+    vpp = None
+    per_stage = getattr(args, "num_layers_per_virtual_pipeline_stage", None)
+    if per_stage:
+        pp = args.pipeline_model_parallel_size
+        vpp = model.num_layers // (pp * per_stage)
+        if vpp * pp * per_stage != model.num_layers:
+            raise ValueError(
+                f"num_layers={model.num_layers} not divisible by "
+                f"pp*per_stage={pp}*{per_stage}")
     parallel = ParallelConfig(
         tensor_parallel=args.tensor_model_parallel_size,
         pipeline_parallel=args.pipeline_model_parallel_size,
         context_parallel=args.context_parallel_size,
         sequence_parallel=args.sequence_parallel,
+        virtual_pipeline_parallel=vpp if (vpp or 0) > 1 else None,
     ).validate()
 
     optimizer = OptimizerConfig(
         optimizer=args.optimizer,
+        sgd_momentum=args.sgd_momentum,
+        log_num_zeros_in_grad=getattr(args, "log_num_zeros_in_grad", False),
         lr=args.lr, min_lr=args.min_lr,
         lr_decay_style=args.lr_decay_style,
         lr_decay_iters=args.lr_decay_iters,
@@ -258,7 +377,10 @@ def args_to_run_config(args) -> RunConfig:
         log_interval=args.log_interval,
         tensorboard_dir=args.tensorboard_dir,
         wandb_logger=args.wandb_logger,
+        wandb_project=getattr(args, "wandb_project", "megatron_tpu"),
+        wandb_name=getattr(args, "wandb_name", None),
         timing_log_level=args.timing_log_level,
+        eval_only=getattr(args, "eval_only", False),
         scalar_loss_mask=args.scalar_loss_mask,
         variable_seq_lengths=args.variable_seq_lengths,
         metrics=tuple(args.metrics),
@@ -266,6 +388,27 @@ def args_to_run_config(args) -> RunConfig:
 
     return RunConfig(model=model, parallel=parallel, optimizer=optimizer,
                      training=training).validate()
+
+
+def _model_config_from_checkpoint(load: str, iteration=None):
+    """ModelConfig from a checkpoint's saved run config
+    (ref: load_args_from_checkpoint, checkpointing.py:482-567)."""
+    import json
+    import os
+
+    from megatron_tpu.training.checkpointing import checkpoint_dir, read_tracker
+
+    it = iteration if iteration is not None else read_tracker(load)
+    if it is None:
+        return None
+    meta_path = os.path.join(checkpoint_dir(load, it), "meta.json")
+    if not os.path.exists(meta_path):
+        return None
+    with open(meta_path) as f:
+        saved = json.load(f).get("config", {})
+    if "model" not in saved:
+        return None
+    return ModelConfig(**saved["model"]).validate()
 
 
 def _dtype_name(args) -> str:

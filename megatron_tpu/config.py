@@ -342,7 +342,12 @@ class TrainingConfig:
     log_interval: int = 100
     tensorboard_dir: Optional[str] = None
     wandb_logger: bool = False
+    wandb_project: str = "megatron_tpu"
+    wandb_name: Optional[str] = None
     timing_log_level: int = 0
+
+    # run only the validation loop, then exit (ref --eval_only)
+    eval_only: bool = False
 
     # loss averaging for instruction tuning (ref finetune.py scalar_loss_mask)
     scalar_loss_mask: float = 0.0

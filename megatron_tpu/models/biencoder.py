@@ -1,0 +1,133 @@
+"""ICT/REALM biencoder: dual BERT towers for retrieval pretraining.
+
+Equivalent of megatron/model/biencoder_model.py (345 LoC): a query tower
+and a context tower (optionally shared weights,
+--biencoder_shared_query_context_model), each embedding text as a linear
+``ict_head`` projection of the [CLS] hidden state
+(PretrainedBertModel:255-330), trained with the in-batch softmax
+retrieval objective of pretrain_ict.py:76-118 — scores = Q @ C^T over the
+global batch, labels on the diagonal, optional 1/sqrt(H) score scaling,
+top-k retrieval accuracies reported. The reference's explicit
+all-gather-over-DP autograd function (pretrain_ict.py:86-133) is
+unnecessary here: under jit the loss sees the global batch and GSPMD
+inserts the gather.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from megatron_tpu.config import ModelConfig
+from megatron_tpu.models.bert import bert_config
+from megatron_tpu.models.language_model import lm_forward
+from megatron_tpu.models.params import init_params, param_specs
+from megatron_tpu.ops.cross_entropy import cross_entropy_loss
+
+
+def biencoder_config(**kw) -> ModelConfig:
+    base = dict(bert_binary_head=False)  # no pooler/MLM head in the towers
+    base.update(kw)
+    return bert_config(**base)
+
+
+def biencoder_init_params(
+    cfg: ModelConfig,
+    key: jax.Array,
+    ict_head_size: int = 128,
+    shared: bool = False,
+) -> Dict[str, Any]:
+    """{"query": tower, "context": tower} or {"shared": tower}; each tower
+    is encoder params + ict_head {w, b}."""
+    def tower(name: str) -> Dict[str, Any]:
+        k = jax.random.fold_in(key, zlib.crc32(name.encode()) & 0x7FFFFFFF)
+        p = init_params(cfg, k)
+        kh = jax.random.fold_in(k, zlib.crc32(b"ict_head") & 0x7FFFFFFF)
+        p["ict_head"] = {
+            "w": (jax.random.normal(kh, (cfg.hidden_size, ict_head_size),
+                                    jnp.float32)
+                  * cfg.init_method_std).astype(cfg.dtype),
+            "b": jnp.zeros((ict_head_size,), cfg.dtype),
+        }
+        return p
+
+    if shared:
+        return {"shared": tower("shared")}
+    return {"query": tower("query"), "context": tower("context")}
+
+
+def biencoder_param_specs(cfg: ModelConfig, shared: bool = False) -> Dict[str, Any]:
+    def tower():
+        s = param_specs(cfg)
+        s["ict_head"] = {"w": P(), "b": P()}
+        return s
+
+    if shared:
+        return {"shared": tower()}
+    return {"query": tower(), "context": tower()}
+
+
+def embed_text(
+    cfg: ModelConfig,
+    tower: Dict[str, Any],
+    tokens: jnp.ndarray,            # [B, S]
+    padding_mask: jnp.ndarray,      # [B, S] True = real
+    dropout_key: Optional[jax.Array] = None,
+) -> jnp.ndarray:
+    """[B, ict_head_size] embedding: ict_head([CLS] hidden)
+    (ref biencoder_model.py embed_text:145-155)."""
+    hidden = lm_forward(cfg, tower, tokens, dropout_key=dropout_key,
+                        return_hidden=True, attention_mask=padding_mask)
+    h = hidden[:, 0]
+    return h @ tower["ict_head"]["w"] + tower["ict_head"]["b"]
+
+
+def biencoder_forward(
+    cfg: ModelConfig,
+    params: Dict[str, Any],
+    query_tokens, query_pad_mask, context_tokens, context_pad_mask,
+    dropout_key: Optional[jax.Array] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    qt = params.get("shared", params.get("query"))
+    ct = params.get("shared", params.get("context"))
+    kq = kc = None
+    if dropout_key is not None:
+        kq, kc = jax.random.split(dropout_key)
+    q = embed_text(cfg, qt, query_tokens, query_pad_mask, kq)
+    c = embed_text(cfg, ct, context_tokens, context_pad_mask, kc)
+    return q, c
+
+
+def biencoder_loss(
+    cfg: ModelConfig,
+    params: Dict[str, Any],
+    batch: Dict[str, jnp.ndarray],
+    dropout_key: Optional[jax.Array] = None,
+    score_scaling: bool = False,
+    topk: Tuple[int, ...] = (1, 5),
+    sharder=None,  # accepted for train-loop compatibility; towers are DP-only
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """batch: query_tokens, query_pad_mask, context_tokens,
+    context_pad_mask. In-batch softmax with diagonal labels
+    (ref pretrain_ict.py loss_func:76-118)."""
+    q, c = biencoder_forward(
+        cfg, params, batch["query_tokens"], batch["query_pad_mask"] > 0,
+        batch["context_tokens"], batch["context_pad_mask"] > 0, dropout_key)
+    scores = jnp.einsum("qd,cd->qc", q.astype(jnp.float32),
+                        c.astype(jnp.float32))
+    if score_scaling:
+        scores = scores / jnp.sqrt(jnp.asarray(cfg.hidden_size, jnp.float32))
+    B = scores.shape[0]
+    labels = jnp.arange(B)
+    loss, _ = cross_entropy_loss(scores[:, None, :], labels[:, None])
+    aux = {"loss": loss}
+    ranks = jnp.sum(
+        (scores > jnp.take_along_axis(scores, labels[:, None], axis=1)),
+        axis=1)
+    for k in topk:
+        aux[f"top{k}_acc"] = jnp.mean((ranks < k).astype(jnp.float32))
+    return loss, aux

@@ -44,7 +44,7 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from megatron_tpu.config import ModelConfig
 from megatron_tpu.models.language_model import (
@@ -175,6 +175,27 @@ def make_pipeline_loss_fn(
         mbs = gb // M
         split = lambda x: x.reshape((M, mbs) + x.shape[1:])
         tokens, labels, loss_mask = split(tokens), split(labels), split(loss_mask)
+        position_ids = batch.get("position_ids")
+        if position_ids is not None:
+            position_ids = split(position_ids)
+
+        # Replicate the (tiny, int) batch tensors before they enter the
+        # manual region: if they stay data/context-sharded, the embed and
+        # loss lax.cond branches need GSPMD resharding collectives INSIDE a
+        # conditional that only some pipe stages execute — a deadlock (all
+        # participants never arrive). Observed on XLA:CPU; the hazard is
+        # real on any backend.
+        rep = NamedSharding(mesh, P())
+        tokens = jax.lax.with_sharding_constraint(tokens, rep)
+        labels = jax.lax.with_sharding_constraint(labels, rep)
+        loss_mask = jax.lax.with_sharding_constraint(loss_mask, rep)
+        if position_ids is not None:
+            position_ids = jax.lax.with_sharding_constraint(position_ids, rep)
+        else:
+            # plain arange; kept explicit so packed positions
+            # (--reset_position_ids) flow through the same path
+            position_ids = jnp.broadcast_to(
+                jnp.arange(S, dtype=jnp.int32)[None, None, :], (M, mbs, S))
 
         dropout_on = dropout_key is not None and (
             model_cfg.hidden_dropout > 0 or model_cfg.attention_dropout > 0)
@@ -194,7 +215,7 @@ def make_pipeline_loss_fn(
         if V > 1:
             layers = jax.tree.map(lambda a: jnp.take(a, place, axis=0), layers)
 
-        def pipelined(layers, other, tokens, labels, loss_mask, key):
+        def pipelined(layers, other, tokens, positions, labels, loss_mask, key):
             params_local = dict(other, layers=layers)
             stage = jax.lax.axis_index("pipe")
             is_first = stage == 0
@@ -211,6 +232,9 @@ def make_pipeline_loss_fn(
                 c = j // Pn                       # chunk slot on this stage
                 m = g * Pn + j % Pn               # microbatch index
 
+                pos_m = jax.lax.dynamic_index_in_dim(
+                    positions, m, 0, keepdims=False)
+
                 def embed(state):
                     ek = None
                     if dropout_on and model_cfg.hidden_dropout > 0:
@@ -219,7 +243,8 @@ def make_pipeline_loss_fn(
                     toks = jax.lax.dynamic_index_in_dim(
                         tokens, m, 0, keepdims=False)
                     return _embed_onehot(model_cfg, params_local, toks,
-                                         ek).astype(model_cfg.dtype)
+                                         ek, positions=pos_m
+                                         ).astype(model_cfg.dtype)
 
                 x = jax.lax.cond(is_first & (c == 0) & valid, embed,
                                  lambda s: s, state)
@@ -232,7 +257,7 @@ def make_pipeline_loss_fn(
                 global_offset = (c * Pn + stage) * Lv
                 key_t = (jax.random.fold_in(key, m) if dropout_on else None)
                 out = _stage_fn(model_cfg, chunk_layers, x, rope,
-                                None, key_t, global_offset, Lv, recompute,
+                                pos_m, key_t, global_offset, Lv, recompute,
                                 sharder=sharder)
 
                 def with_loss(_):
@@ -273,7 +298,7 @@ def make_pipeline_loss_fn(
         in_specs = (
             jax.tree.map(lambda _: P("pipe"), layers),
             jax.tree.map(lambda _: P(), other),
-            P(), P(), P(), P(),
+            P(), P(), P(), P(), P(),
         )
         fn = jax.shard_map(
             pipelined,
@@ -283,8 +308,8 @@ def make_pipeline_loss_fn(
             axis_names={"pipe"},
             check_vma=False,
         )
-        mean_loss, ntokens = fn(layers, other, tokens, labels,
-                                loss_mask, key_arg)
+        mean_loss, ntokens = fn(layers, other, tokens, position_ids,
+                                labels, loss_mask, key_arg)
         return mean_loss, {"lm_loss": mean_loss, "ntokens": ntokens}
 
     return loss_fn

@@ -65,13 +65,21 @@ def get_ltor_masks_and_position_ids(
     return loss_mask, position_ids
 
 
-def gpt_collate(items, eod_token=None, eod_mask_loss=False):
-    """'text' [seq+1] items -> tokens/labels/loss_mask batch."""
+def gpt_collate(items, eod_token=None, eod_mask_loss=False,
+                reset_position_ids=False):
+    """'text' [seq+1] items -> tokens/labels/loss_mask batch (+ packed
+    position_ids with --reset_position_ids)."""
     text = np.stack([it["text"] for it in items]).astype(np.int64)
     tokens, labels = text[:, :-1], text[:, 1:]
-    loss_mask, _ = get_ltor_masks_and_position_ids(
-        labels, eod_token, eod_mask_loss=eod_mask_loss)
-    return {"tokens": tokens, "labels": labels, "loss_mask": loss_mask}
+    _, position_ids = get_ltor_masks_and_position_ids(
+        tokens, eod_token, reset_position_ids=reset_position_ids)
+    loss_mask = np.ones(labels.shape, np.float32)
+    if eod_mask_loss and eod_token is not None:
+        loss_mask[labels == eod_token] = 0.0
+    batch = {"tokens": tokens, "labels": labels, "loss_mask": loss_mask}
+    if reset_position_ids:
+        batch["position_ids"] = position_ids
+    return batch
 
 
 class TrainLoop:
@@ -142,6 +150,8 @@ class TrainLoop:
         self.writer = Writer(
             tensorboard_dir=run_cfg.training.tensorboard_dir,
             wandb=run_cfg.training.wandb_logger,
+            wandb_project=run_cfg.training.wandb_project,
+            wandb_name=run_cfg.training.wandb_name,
             config=run_cfg.to_dict())
 
     # -- checkpoint ---------------------------------------------------------
@@ -266,6 +276,14 @@ class TrainLoop:
         """train_iter_factory(consumed_samples, global_batch) returns an
         iterator of global batches at that batch size (rampup-aware)."""
         t = self.cfg.training
+        if t.eval_only:
+            if valid_iter_factory is None:
+                self.log("--eval_only with no validation data; nothing to do")
+                return self.state
+            ev = self.evaluate(valid_iter_factory(), t.eval_iters)
+            self.log(f"validation | lm loss: {ev['lm_loss']:.6f} | "
+                     f"ppl: {ev['ppl']:.3f}")
+            return self.state
         model_flops_per_token = 3.0 * self.cfg.model.flops_per_token_fwd()
         start_time = time.time()
         window_tokens = 0

@@ -33,8 +33,6 @@ def extra_args(p):
     g.add_argument("--cls_token_id", type=int, default=101)
     g.add_argument("--sep_token_id", type=int, default=102)
     g.add_argument("--pad_token_id", type=int, default=0)
-    g.add_argument("--masked_lm_prob", type=float, default=0.15)
-    g.add_argument("--short_seq_prob", type=float, default=0.1)
     g.add_argument("--no_binary_head", action="store_true")
     return p
 
@@ -76,7 +74,7 @@ def main(argv=None):
         mask_token=args.mask_token_id, cls_token=args.cls_token_id,
         sep_token=args.sep_token_id, pad_token=args.pad_token_id,
         vocab_size=cfg.model.vocab_size, seed=t.seed,
-        masked_lm_prob=args.masked_lm_prob,
+        masked_lm_prob=args.mask_prob,
         short_seq_prob=args.short_seq_prob,
         binary_head=not args.no_binary_head)
 

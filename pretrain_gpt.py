@@ -47,9 +47,14 @@ def main(argv=None):
         (n_train, n_valid, t.eval_iters * t.global_batch_size),
         seed=t.seed, cache_dir=args.data_cache_dir)
 
-    eod = None  # eod-aware loss masking needs the tokenizer's eod id
-    collate = lambda items: gpt_collate(items, eod_token=eod,
-                                        eod_mask_loss=args.eod_mask_loss)
+    eod = args.eod_token_id
+    if (args.eod_mask_loss or args.reset_position_ids) and eod is None:
+        raise SystemExit(
+            "--eod_mask_loss/--reset_position_ids need --eod_token_id "
+            "(the data is pre-tokenized; there is no tokenizer to ask)")
+    collate = lambda items: gpt_collate(
+        items, eod_token=eod, eod_mask_loss=args.eod_mask_loss,
+        reset_position_ids=args.reset_position_ids)
 
     def train_iter_factory(consumed, gbs):
         sampler = PretrainingSampler(

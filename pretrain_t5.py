@@ -33,12 +33,9 @@ from megatron_tpu.arguments import args_to_run_config, parse_args
 def extra_args(p):
     g = p.add_argument_group("t5")
     g.add_argument("--decoder_seq_length", type=int, default=128)
-    g.add_argument("--vocab_extra_ids", type=int, default=100)
     g.add_argument("--bos_token_id", type=int, default=101)
     g.add_argument("--eos_token_id", type=int, default=102)
     g.add_argument("--pad_token_id", type=int, default=0)
-    g.add_argument("--masked_lm_prob", type=float, default=0.15)
-    g.add_argument("--short_seq_prob", type=float, default=0.1)
     return p
 
 
@@ -71,7 +68,11 @@ def main(argv=None):
     # sentinels from the top of the padded vocab (ref: tokenizer
     # additional_special_tokens via --vocab_extra_ids)
     v = cfg.model.vocab_size
-    sentinels = list(range(v - args.vocab_extra_ids, v))
+    n_extra = 100 if args.vocab_extra_ids is None else args.vocab_extra_ids
+    if n_extra <= 0:
+        raise SystemExit("T5 span corruption needs sentinel ids: pass "
+                         "--vocab_extra_ids N (the reference uses 100)")
+    sentinels = list(range(v - n_extra, v))
 
     t = cfg.training
     indexed = make_dataset(args.data_path[0])
@@ -82,7 +83,7 @@ def main(argv=None):
         max_seq_length_dec=args.decoder_seq_length,
         bos_token=args.bos_token_id, eos_token=args.eos_token_id,
         pad_token=args.pad_token_id, sentinel_tokens=sentinels,
-        seed=t.seed, masked_lm_prob=args.masked_lm_prob,
+        seed=t.seed, masked_lm_prob=args.mask_prob,
         short_seq_prob=args.short_seq_prob)
 
     def train_iter_factory(consumed, gbs):

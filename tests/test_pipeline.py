@@ -121,3 +121,32 @@ def test_interleaved_vpp_microbatch_constraint():
     with pytest.raises(ValueError, match="num_microbatches"):
         make_pipeline_loss_fn(cfg, rt.mesh, num_stages=2, num_microbatches=3,
                               recompute="full", num_virtual_chunks=2)
+
+
+def test_pipeline_train_loop_with_data_parallel():
+    """dp>1 x pp through the full TrainLoop (regression: data-sharded batch
+    tensors entering the pipe-manual region forced GSPMD resharding
+    collectives inside stage-conditional branches -> deadlock)."""
+    from megatron_tpu.config import ModelConfig, RunConfig
+    from megatron_tpu.training.pretrain import TrainLoop
+
+    model = ModelConfig(num_layers=4, hidden_size=32, num_attention_heads=4,
+                        num_kv_heads=2, ffn_hidden_size=64, vocab_size=128,
+                        seq_length=32, params_dtype="float32").validate()
+    cfg = RunConfig(model=model,
+                    parallel=ParallelConfig(pipeline_parallel=2),
+                    optimizer=OptimizerConfig(lr=1e-3,
+                                              lr_decay_style="constant"),
+                    training=TrainingConfig(micro_batch_size=1,
+                                            global_batch_size=8,
+                                            train_iters=2, log_interval=1))
+    loop = TrainLoop(cfg, log=lambda s: None)
+    assert loop.rt.dp == 4
+    rng = np.random.default_rng(0)
+    batch = {"tokens": rng.integers(0, 128, (8, 32)).astype(np.int64),
+             "labels": rng.integers(0, 128, (8, 32)).astype(np.int64),
+             "loss_mask": np.ones((8, 32), np.float32)}
+    m1 = loop.train_step(batch)
+    m2 = loop.train_step(batch)
+    assert np.isfinite(float(m1["loss"]))
+    assert float(m2["loss"]) < float(m1["loss"])

@@ -1,0 +1,28 @@
+"""push_to_hub tool (ref: tools/push_to_hub.py — validation + dry-run;
+the actual upload needs network and is exercised only in real runs)."""
+
+import json
+
+import pytest
+
+
+def test_dry_run_on_hf_dir(tmp_path, capsys):
+    from tools import push_to_hub
+
+    d = tmp_path / "hf"
+    d.mkdir()
+    (d / "config.json").write_text(json.dumps({"model_type": "llama"}))
+    (d / "pytorch_model.bin").write_bytes(b"\0" * 128)
+    out = push_to_hub.main([str(d), "--hub_repo", "me/test", "--dry_run"])
+    assert out == str(d)
+    cap = capsys.readouterr().out
+    assert "dry run" in cap and "pytorch_model.bin" in cap
+
+
+def test_rejects_non_model_dir(tmp_path):
+    from tools import push_to_hub
+
+    d = tmp_path / "empty"
+    d.mkdir()
+    with pytest.raises(SystemExit, match="does not look like"):
+        push_to_hub.main([str(d), "--hub_repo", "me/test", "--dry_run"])

@@ -71,3 +71,19 @@ def test_model_forward_with_ring_impl():
         got = jax.jit(lambda p, t: lm_forward(cfg_ring, p, t))(params, tokens)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_zigzag_fallback_when_seq_not_divisible():
+    """S % 2cp != 0 falls back to the contiguous path, still exact."""
+    rt = build_mesh(ParallelConfig(context_parallel=4))
+    rng = np.random.default_rng(3)
+    S = 20  # 20 % 8 != 0, but 20 % 4 == 0
+    q = jnp.asarray(rng.standard_normal((1, S, 4, 16)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, S, 2, 16)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, S, 2, 16)), jnp.float32)
+    want = attention(q, k, v)
+    with jax.sharding.set_mesh(rt.mesh):
+        got = jax.jit(lambda q, k, v: ring_attention_sharded(
+            q, k, v, rt.mesh))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
