@@ -26,7 +26,7 @@ class ICTDataset:
         self,
         block_dataset: MMapIndexedDataset,   # sentence-level + doc bounds
         title_dataset: Optional[MMapIndexedDataset],
-        num_samples: int,
+        num_samples: Optional[int],   # None = exactly one epoch of blocks
         max_seq_length: int,
         cls_token: int,
         sep_token: int,
@@ -45,17 +45,23 @@ class ICTDataset:
         title_sizes = (title_dataset.sizes if self.titles is not None
                        else np.zeros(len(block_dataset.doc_idx) - 1, np.int32))
         n_docs = max(len(block_dataset.doc_idx) - 1, 1)
+        if num_samples is None:
+            # one epoch: each block appears exactly once (indexer pass)
+            num_epochs, max_num = 1, 2**62
+        else:
+            num_epochs = max(1, int(np.ceil(num_samples / n_docs)) + 1)
+            max_num = num_samples
         self.mapping = helpers.build_blocks_mapping(
             block_dataset.doc_idx, block_dataset.sizes, title_sizes,
-            num_epochs=max(1, int(np.ceil(num_samples / n_docs)) + 1),
-            max_num_samples=num_samples,
+            num_epochs=num_epochs,
+            max_num_samples=max_num,
             max_seq_length=max_seq_length - 3, seed=seed,
             use_one_sent_blocks=use_one_sent_docs)
 
     def __len__(self) -> int:
         return self.mapping.shape[0]
 
-    def _pad(self, tokens, title=None) -> Dict[str, np.ndarray]:
+    def _pad(self, tokens, title=None) -> "tuple[np.ndarray, np.ndarray]":
         toks = [self.cls]
         if title is not None:
             toks += list(title) + [self.sep]

@@ -101,18 +101,32 @@ def t5_param_shapes(cfg: ModelConfig) -> Dict[str, tuple]:
 
 
 def t5_param_specs(cfg: ModelConfig) -> Dict[str, Any]:
-    """Sharding specs matching t5_init_params's tree. Replicated (pure-DP)
-    for now — T5 tensor-parallel specs are a follow-up; the GPT family is
-    the TP-first path."""
+    """Sharding specs matching t5_init_params's tree: Megatron-style TP —
+    QKV/MLP-in column-split (last dim over "tensor"), attention-out /
+    MLP-out row-split (contraction dim over "tensor"), vocab-parallel
+    embedding; norms and biases-of-row-projections replicated
+    (ref: core/tensor_parallel/layers.py Column/RowParallelLinear)."""
     from jax.sharding import PartitionSpec as P
 
+    def spec_for(path: str, shape) -> P:
+        leaf = path.rsplit("/", 1)[-1]
+        if leaf == "tokens":                       # [V, h] vocab-parallel
+            return P("tensor", None)
+        if leaf in ("wq", "wk", "wv", "w_in"):     # [L, h, out] column
+            return P(None, None, "tensor")
+        if leaf in ("wq_b", "wk_b", "wv_b", "w_in_b"):  # [L, out]
+            return P(None, "tensor")
+        if leaf in ("wo", "w_out"):                # [L, in, h] row
+            return P(None, "tensor", None)
+        return P(*(None,) * len(shape))
+
     out: Dict[str, Any] = {}
-    for path in t5_param_shapes(cfg):
+    for path, shape in t5_param_shapes(cfg).items():
         node = out
         parts = path.split("/")
         for p in parts[:-1]:
             node = node.setdefault(p, {})
-        node[parts[-1]] = P()
+        node[parts[-1]] = spec_for(path, shape)
     return out
 
 

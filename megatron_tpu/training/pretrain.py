@@ -91,11 +91,16 @@ class TrainLoop:
         log: Callable[[str], None] = print,
         init_params_fn: Optional[Callable] = None,
         param_specs_fn: Optional[Callable] = None,
+        loss_fn: Optional[Callable] = None,
+        fixed_num_microbatches: Optional[int] = None,
     ):
         """init_params_fn(model_cfg, key) / param_specs_fn(model_cfg) let
         task entry points with their own parameter trees (T5's separate
         encoder/decoder stacks) reuse the loop; default is the GPT-family
-        language model."""
+        language model. loss_fn(model_cfg, params, batch, key) swaps the
+        training objective (BERT/T5/ICT entries); fixed_num_microbatches
+        pins the microbatch count regardless of batch size (ICT's in-batch
+        softmax needs the whole global batch as negatives)."""
         run_cfg.validate()
         self.cfg = run_cfg
         self.log = log
@@ -141,9 +146,14 @@ class TrainLoop:
 
         self._sharder = sharder
         self._step_cache: Dict[int, Callable] = {}
+        self.loss_fn = loss_fn
+        self.fixed_num_microbatches = fixed_num_microbatches
         self.eval_step = None
-        # task entry points (BERT/T5) set this to their loss for evaluate()
+        # task entry points (BERT/T5/ICT) set this to their loss for
+        # evaluate(); defaults to loss_fn without the dropout key
         self.eval_loss_fn = None
+        if loss_fn is not None:
+            self.eval_loss_fn = lambda mc, p, b: loss_fn(mc, p, b, None)
 
         from megatron_tpu.training.logging_writer import Writer
 
@@ -185,10 +195,12 @@ class TrainLoop:
     def _train_step_for(self, num_microbatches: int) -> Callable:
         """Jitted step per microbatch count (rampup re-jits per level,
         like the reference re-deriving num_microbatches per iteration)."""
+        if self.fixed_num_microbatches is not None:
+            num_microbatches = self.fixed_num_microbatches
         if num_microbatches not in self._step_cache:
             pp = self.rt.pp
             pp_loss_fn = None
-            if pp > 1:
+            if pp > 1 and self.loss_fn is None:
                 pp_loss_fn = make_pipeline_loss_fn(
                     self.cfg.model, self.rt.mesh, pp, num_microbatches,
                     recompute=self.cfg.training.recompute_granularity,
@@ -200,6 +212,7 @@ class TrainLoop:
                 num_microbatches=num_microbatches,
                 train_iters=self.cfg.training.train_iters or 1,
                 sharder=self._sharder,
+                loss_fn=self.loss_fn,
                 pipeline_loss_fn=pp_loss_fn)
             # batch leaves were placed by _put_batch (rank-aware specs);
             # let jit infer their shardings from the arguments

@@ -82,32 +82,10 @@ def main(argv=None):
         sampler = PretrainingSampler(len(train_ds), consumed, gbs, 0, 1)
         return build_data_loader(train_ds, sampler)
 
-    loop = TrainLoop(cfg)
-
-    # swap the LM loss for the BERT loss
-    from megatron_tpu.training.train_step import make_train_step
-
     def bert_loss_fn(model_cfg, p, b, key):
-        return bert_loss(model_cfg, p, b, dropout_key=key,
-                         sharder=loop._sharder)
+        return bert_loss(model_cfg, p, b, dropout_key=key)
 
-    def step_for(n_micro):
-        if n_micro not in loop._step_cache:
-            import jax
-
-            step = make_train_step(cfg.model, cfg.optimizer, t,
-                                   num_microbatches=n_micro,
-                                   train_iters=t.train_iters,
-                                   sharder=loop._sharder,
-                                   loss_fn=bert_loss_fn)
-            loop._step_cache[n_micro] = jax.jit(
-                step, in_shardings=(loop.state_shardings, None),
-                donate_argnums=(0,))
-        return loop._step_cache[n_micro]
-
-    loop._train_step_for = step_for
-    loop.eval_loss_fn = lambda mc, p, b: bert_loss(mc, p, b,
-                                                   sharder=loop._sharder)
+    loop = TrainLoop(cfg, loss_fn=bert_loss_fn)
     loop.train(train_iter_factory)
 
 

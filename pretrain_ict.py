@@ -96,43 +96,24 @@ def main(argv=None):
         sampler = PretrainingSampler(len(train_ds), consumed, gbs, 0, 1)
         return build_data_loader(train_ds, sampler, collate_fn=collate)
 
-    loop = TrainLoop(
-        cfg,
-        init_params_fn=functools.partial(
-            biencoder_init_params, ict_head_size=args.ict_head_size,
-            shared=shared),
-        param_specs_fn=functools.partial(biencoder_param_specs, shared=shared))
-
-    from megatron_tpu.training.train_step import make_train_step
-
     def loss_fn(model_cfg, p, b, key):
         return biencoder_loss(model_cfg, p, b, dropout_key=key,
                               score_scaling=args.retriever_score_scaling,
                               topk=tuple(args.retriever_report_topk_accuracies))
 
-    def step_for(n_micro):
-        # The in-batch softmax needs the WHOLE global batch as negatives
-        # (the reference all-gathers embeddings across DP for exactly this,
-        # pretrain_ict.py:86-133); a microbatch loop would shrink the
-        # candidate set — with micro_batch_size*dp == 1 the loss would be
-        # identically log(1) = 0. Always run one full-batch "microbatch".
-        del n_micro
-        if 1 not in loop._step_cache:
-            import jax
-
-            step = make_train_step(cfg.model, cfg.optimizer, t,
-                                   num_microbatches=1,
-                                   train_iters=t.train_iters,
-                                   sharder=loop._sharder,
-                                   loss_fn=loss_fn)
-            loop._step_cache[1] = jax.jit(
-                step, in_shardings=(loop.state_shardings, None),
-                donate_argnums=(0,))
-        return loop._step_cache[1]
-
-    loop._train_step_for = step_for
-    loop.eval_loss_fn = lambda mc, p, b: biencoder_loss(
-        mc, p, b, score_scaling=args.retriever_score_scaling)
+    # fixed_num_microbatches=1: the in-batch softmax needs the WHOLE global
+    # batch as negatives (the reference all-gathers embeddings across DP for
+    # exactly this, pretrain_ict.py:86-133); a microbatch loop would shrink
+    # the candidate set — with micro_batch_size*dp == 1 the loss would be
+    # identically log(1) = 0.
+    loop = TrainLoop(
+        cfg,
+        init_params_fn=functools.partial(
+            biencoder_init_params, ict_head_size=args.ict_head_size,
+            shared=shared),
+        param_specs_fn=functools.partial(biencoder_param_specs, shared=shared),
+        loss_fn=loss_fn,
+        fixed_num_microbatches=1)
     loop.train(train_iter_factory)
 
 

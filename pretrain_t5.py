@@ -90,30 +90,11 @@ def main(argv=None):
         sampler = PretrainingSampler(len(train_ds), consumed, gbs, 0, 1)
         return build_data_loader(train_ds, sampler)
 
-    loop = TrainLoop(cfg, init_params_fn=t5_init_params,
-                     param_specs_fn=t5_param_specs)
-
-    from megatron_tpu.training.train_step import make_train_step
-
     def t5_loss_fn(model_cfg, p, b, key):
         return t5_loss(model_cfg, p, b)
 
-    def step_for(n_micro):
-        if n_micro not in loop._step_cache:
-            import jax
-
-            step = make_train_step(cfg.model, cfg.optimizer, t,
-                                   num_microbatches=n_micro,
-                                   train_iters=t.train_iters,
-                                   sharder=loop._sharder,
-                                   loss_fn=t5_loss_fn)
-            loop._step_cache[n_micro] = jax.jit(
-                step, in_shardings=(loop.state_shardings, None),
-                donate_argnums=(0,))
-        return loop._step_cache[n_micro]
-
-    loop._train_step_for = step_for
-    loop.eval_loss_fn = lambda mc, p, b: t5_loss(mc, p, b)
+    loop = TrainLoop(cfg, init_params_fn=t5_init_params,
+                     param_specs_fn=t5_param_specs, loss_fn=t5_loss_fn)
     loop.train(train_iter_factory)
 
 

@@ -76,34 +76,16 @@ def finetune_classification(cfg, num_classes: int, train_ds, valid_ds,
     """Train with the classification loss; returns the loop (state inside).
     cfg.training.train_iters must already reflect epochs * len / gbs."""
     import functools
-    import jax
 
-    from megatron_tpu.training.train_step import make_train_step
+    def loss_fn(model_cfg, p, b, key):
+        return classification_loss(model_cfg, p, b, dropout_key=key)
 
     loop = TrainLoop(
         cfg, log=log,
         init_params_fn=functools.partial(cls_init_params,
                                          num_classes=num_classes),
-        param_specs_fn=cls_param_specs)
-
-    def loss_fn(model_cfg, p, b, key):
-        return classification_loss(model_cfg, p, b, dropout_key=key,
-                                   sharder=loop._sharder)
-
-    def step_for(n_micro):
-        if n_micro not in loop._step_cache:
-            step = make_train_step(cfg.model, cfg.optimizer, cfg.training,
-                                   num_microbatches=n_micro,
-                                   train_iters=cfg.training.train_iters,
-                                   sharder=loop._sharder, loss_fn=loss_fn)
-            loop._step_cache[n_micro] = jax.jit(
-                step, in_shardings=(loop.state_shardings, None),
-                donate_argnums=(0,))
-        return loop._step_cache[n_micro]
-
-    loop._train_step_for = step_for
-    loop.eval_loss_fn = lambda mc, p, b: classification_loss(
-        mc, p, b, sharder=loop._sharder)
+        param_specs_fn=cls_param_specs,
+        loss_fn=loss_fn)
 
     seed = cfg.training.seed
 

@@ -54,13 +54,22 @@ def main(argv=None):
             conv += ["--model_type", args.model_type]
         native_to_hf.main(conv)
         path = tmp
+    try:
+        return _validate_and_upload(args, path)
+    finally:
+        if tmp is not None and not args.dry_run:
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _validate_and_upload(args, path):
 
     # validate: the directory must look like an HF model
     needed = ["config.json"]
     have = set(os.listdir(path))
     missing = [n for n in needed if n not in have]
-    weights = [f for f in have
-               if f.endswith((".bin", ".safetensors")) or f == "pytorch_model.bin"]
+    weights = [f for f in have if f.endswith((".bin", ".safetensors"))]
     if missing or not weights:
         raise SystemExit(
             f"{path} does not look like an HF model dir "
