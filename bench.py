@@ -16,6 +16,7 @@ vs_baseline is our MFU / that.
 """
 
 import json
+import os
 import sys
 import time
 
@@ -69,11 +70,16 @@ def main():
     float(metrics["loss"])
 
     iters = 5
+    profile_dir = os.environ.get("MEGATRON_TPU_PROFILE_DIR")
+    if profile_dir:
+        jax.profiler.start_trace(profile_dir)
     t0 = time.perf_counter()
     for _ in range(iters):
         state, metrics = step(state, batch)
     loss_val = float(metrics["loss"])
     dt = (time.perf_counter() - t0) / iters
+    if profile_dir:
+        jax.profiler.stop_trace()
 
     tokens_per_sec = micro_bs * cfg.seq_length / dt
     flops_per_token = 3.0 * cfg.flops_per_token_fwd()  # fwd + bwd(2x)

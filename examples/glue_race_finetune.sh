@@ -1,0 +1,23 @@
+#!/bin/bash
+# GLUE (MNLI) and RACE finetuning over a pretrained BERT-family encoder
+# (counterpart of the reference's tasks/main.py recipes).
+set -e
+
+python -m tasks.main --task MNLI \
+    --train_data glue/MNLI/train.tsv --valid_data glue/MNLI/dev_matched.tsv \
+    --pretrained_checkpoint ckpts/bert \
+    --num_layers 24 --hidden_size 1024 --num_attention_heads 16 \
+    --seq_length 128 --vocab_size 30592 \
+    --tokenizer_type HF --tokenizer_model bert-large-uncased \
+    --epochs 3 --micro_batch_size 8 --global_batch_size 128 \
+    --lr 5e-5 --lr_decay_style linear --lr_warmup_fraction 0.065 --bf16
+
+python -m tasks.main --task RACE \
+    --train_data race/train/middle race/train/high \
+    --valid_data race/dev/middle race/dev/high \
+    --pretrained_checkpoint ckpts/bert \
+    --num_layers 24 --hidden_size 1024 --num_attention_heads 16 \
+    --seq_length 512 --vocab_size 30592 \
+    --tokenizer_type HF --tokenizer_model bert-large-uncased \
+    --epochs 3 --micro_batch_size 4 --global_batch_size 32 \
+    --lr 1e-5 --lr_decay_style linear --lr_warmup_fraction 0.06 --bf16

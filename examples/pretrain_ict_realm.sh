@@ -1,0 +1,21 @@
+#!/bin/bash
+# ICT biencoder pretraining + evidence-block index build
+# (counterpart of the reference's pretrain_ict.py + megatron/indexer.py).
+set -e
+
+python pretrain_ict.py \
+    --num_layers 12 --hidden_size 768 --num_attention_heads 12 \
+    --seq_length 256 --vocab_size 30592 \
+    --data_path data/sents --titles_data_path data/titles \
+    --ict_head_size 128 --retriever_score_scaling \
+    --micro_batch_size 32 --global_batch_size 4096 \
+    --train_iters 100000 --lr 1e-4 --lr_decay_style linear \
+    --lr_warmup_fraction 0.01 --bf16 \
+    --save ckpts/ict --save_interval 2000 --log_interval 100
+
+python tools/build_retrieval_index.py \
+    --num_layers 12 --hidden_size 768 --num_attention_heads 12 \
+    --seq_length 256 --vocab_size 30592 \
+    --data_path data/sents --titles_data_path data/titles \
+    --load ckpts/ict --ict_head_size 128 \
+    --output index/ --indexer_batch_size 128

@@ -1,0 +1,18 @@
+#!/bin/bash
+# Llama-2-70B 3D-parallel pretrain across DCN-connected slices
+# (data axis spans DCN; tp/pp/cp stay inside each slice's ICI).
+# On TPU pods the runtime discovers topology; for explicit clusters set
+# MEGATRON_TPU_COORDINATOR / _NUM_PROCESSES / _PROCESS_ID per host
+# (see docs/multihost.md).
+set -e
+
+MEGATRON_TPU_AUTO_DISTRIBUTED=1 python pretrain_gpt.py \
+    --model_name llama2-70B \
+    --data_path data/corpus --split 989,10,1 \
+    --tensor_model_parallel_size 8 --pipeline_model_parallel_size 4 \
+    --num_layers_per_virtual_pipeline_stage 5 \
+    --sequence_parallel --use_distributed_optimizer \
+    --micro_batch_size 1 --global_batch_size 1024 \
+    --train_iters 50000 --lr 1.5e-4 --lr_decay_style cosine \
+    --lr_warmup_iters 2000 --bf16 --recompute_granularity selective \
+    --save ckpts/llama70b --save_interval 1000 --log_interval 10

@@ -1,0 +1,21 @@
+#!/bin/bash
+# T5-base span-corruption pretraining (counterpart of the reference's
+# pretrain_t5.py recipe): sentence-split data, 100 sentinel ids from the
+# top of the padded vocab.
+set -e
+
+python tools/preprocess_data.py --input corpus.jsonl \
+    --output_prefix data/sents \
+    --tokenizer_type SentencePieceTokenizer --tokenizer_model spm.model \
+    --split_sentences --append_eod --workers 8
+
+python pretrain_t5.py \
+    --num_layers 12 --hidden_size 768 --num_attention_heads 12 \
+    --seq_length 512 --decoder_seq_length 128 \
+    --vocab_size 32128 --vocab_extra_ids 100 \
+    --data_path data/sents \
+    --micro_batch_size 16 --global_batch_size 256 \
+    --train_iters 100000 --lr 1e-4 --lr_decay_style cosine \
+    --lr_warmup_iters 1000 --bf16 \
+    --save ckpts/t5-base --save_interval 2000 \
+    --eval_interval 1000 --log_interval 100

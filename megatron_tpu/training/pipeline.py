@@ -129,6 +129,7 @@ def make_pipeline_loss_fn(
     recompute: str = "selective",
     sharder=None,
     num_virtual_chunks: int = 1,
+    remat_segment: Optional[int] = None,
 ):
     """Returns loss_fn(params, batch, dropout_key) -> (mean_loss, aux).
 
@@ -136,8 +137,14 @@ def make_pipeline_loss_fn(
     rows; the pipeline consumes one microbatch per tick. Requires
     num_layers % (num_stages * num_virtual_chunks) == 0, and — for the
     interleaved schedule — num_microbatches % num_stages == 0.
+
+    remat_segment: rematerialize the tick scan in segments of this many
+    ticks (num_stages is the natural choice), bounding backward-pass live
+    carries to ~(T/seg + seg) instead of one per tick; costs one extra
+    forward replay per segment.
     """
     Pn, M, V = num_stages, num_microbatches, num_virtual_chunks
+    seg = remat_segment
     L = model_cfg.num_layers
     if L % (Pn * V):
         raise ValueError(
@@ -287,9 +294,39 @@ def make_pipeline_loss_fn(
                 (mbs, S, model_cfg.hidden_size),
                 model_cfg.dtype,
             )
-            (state, loss_sum, tok_sum), _ = jax.lax.scan(
-                tick, (h0, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
-                jnp.arange(T))
+            carry0 = (h0, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
+            if seg is None:
+                (state, loss_sum, tok_sum), _ = jax.lax.scan(
+                    tick, carry0, jnp.arange(T))
+            else:
+                # Segmented remat over the tick scan: without it, autodiff
+                # stores one [mbs, S, H] carry per tick — full-batch (GPipe)
+                # activation residency. Rematerializing each segment of
+                # `seg` ticks bounds live carries to T/seg segment
+                # boundaries + seg in-tick residuals, i.e. the reference's
+                # 1F1B-with-recompute memory shape, for one extra forward
+                # replay per segment.
+                n_seg = -(-T // seg)
+                ticks = jnp.arange(n_seg * seg).reshape(n_seg, seg)
+                ragged = n_seg * seg != T
+
+                def segment(carry, tick_ids):
+                    if not ragged:
+                        return jax.lax.scan(tick, carry, tick_ids)
+
+                    def masked_tick(carry, t):
+                        # ticks beyond T are pure padding: keep the carry.
+                        # Deadlock-safe: t < T is uniform across pipe ranks
+                        # (unlike stage-conditional branches).
+                        return jax.lax.cond(
+                            t < T, lambda c: tick(c, t)[0], lambda c: c,
+                            carry), None
+
+                    return jax.lax.scan(masked_tick, carry, tick_ids)
+
+                segment = jax.checkpoint(segment, prevent_cse=False)
+                (state, loss_sum, tok_sum), _ = jax.lax.scan(
+                    segment, carry0, ticks)
             loss_sum = jax.lax.psum(loss_sum, "pipe")
             tok_sum = jax.lax.psum(tok_sum, "pipe")
             return loss_sum / jnp.maximum(tok_sum, 1.0), tok_sum

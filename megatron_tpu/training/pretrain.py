@@ -148,6 +148,12 @@ class TrainLoop:
         self._step_cache: Dict[int, Callable] = {}
         self.loss_fn = loss_fn
         self.fixed_num_microbatches = fixed_num_microbatches
+        if loss_fn is not None and self.rt.pp > 1:
+            raise ValueError(
+                "pipeline parallelism drives the built-in LM loss through "
+                "the pipe schedule; task losses (BERT/T5/ICT/classification)"
+                " would silently train unpipelined — use tensor/data/context"
+                " parallelism for them instead")
         self.eval_step = None
         # task entry points (BERT/T5/ICT) set this to their loss for
         # evaluate(); defaults to loss_fn without the dropout key
@@ -201,12 +207,17 @@ class TrainLoop:
             pp = self.rt.pp
             pp_loss_fn = None
             if pp > 1 and self.loss_fn is None:
+                recompute = self.cfg.training.recompute_granularity
                 pp_loss_fn = make_pipeline_loss_fn(
                     self.cfg.model, self.rt.mesh, pp, num_microbatches,
-                    recompute=self.cfg.training.recompute_granularity,
+                    recompute=recompute,
                     sharder=self._sharder,
                     num_virtual_chunks=(
-                        self.cfg.parallel.virtual_pipeline_parallel or 1))
+                        self.cfg.parallel.virtual_pipeline_parallel or 1),
+                    # full recompute = the memory-pressure regime: also
+                    # segment the tick scan so live carries stay at the
+                    # 1F1B-like ~2*pp bound instead of one per tick
+                    remat_segment=pp if recompute == "full" else None)
             step = make_train_step(
                 self.cfg.model, self.cfg.optimizer, self.cfg.training,
                 num_microbatches=num_microbatches,

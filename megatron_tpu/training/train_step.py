@@ -52,6 +52,15 @@ def make_train_step(
     path, schedules.py:18-33) and this step differentiates the whole batch
     at once.
     """
+    if loss_fn is not None:
+        # thread the activation sharder into task losses that accept it
+        # (the residual-stream constraint IS sequence parallelism here)
+        import inspect
+
+        if "sharder" in inspect.signature(loss_fn).parameters:
+            user_fn = loss_fn
+            loss_fn = (lambda cfg, p, b, key:
+                       user_fn(cfg, p, b, key, sharder=sharder))
     loss_fn = loss_fn or (lambda cfg, p, b, key: lm_loss(
         cfg, p, b, dropout_key=key, recompute=train_cfg.recompute_granularity,
         sharder=sharder))

@@ -82,8 +82,9 @@ def main(argv=None):
         sampler = PretrainingSampler(len(train_ds), consumed, gbs, 0, 1)
         return build_data_loader(train_ds, sampler)
 
-    def bert_loss_fn(model_cfg, p, b, key):
-        return bert_loss(model_cfg, p, b, dropout_key=key)
+    def bert_loss_fn(model_cfg, p, b, key, sharder=None):
+        kw = {"sharder": sharder} if sharder is not None else {}
+        return bert_loss(model_cfg, p, b, dropout_key=key, **kw)
 
     loop = TrainLoop(cfg, loss_fn=bert_loss_fn)
     loop.train(train_iter_factory)

@@ -77,8 +77,9 @@ def finetune_classification(cfg, num_classes: int, train_ds, valid_ds,
     cfg.training.train_iters must already reflect epochs * len / gbs."""
     import functools
 
-    def loss_fn(model_cfg, p, b, key):
-        return classification_loss(model_cfg, p, b, dropout_key=key)
+    def loss_fn(model_cfg, p, b, key, sharder=None):
+        kw = {"sharder": sharder} if sharder is not None else {}
+        return classification_loss(model_cfg, p, b, dropout_key=key, **kw)
 
     loop = TrainLoop(
         cfg, log=log,

@@ -40,6 +40,8 @@ def build_index(cfg, tower, dataset, batch_size: int = 64,
 
     embs, metas = [], []
     n = len(dataset)
+    if n == 0:
+        raise SystemExit("no blocks to index (empty block dataset/mapping)")
     for i in range(0, n, batch_size):
         rows = [dataset[j] for j in range(i, min(i + batch_size, n))]
         pad = batch_size - len(rows)
@@ -94,6 +96,8 @@ def main(argv=None):
     from megatron_tpu.training.optimizer import init_train_state
 
     args = parse_args(argv, extra_args_provider=extra)
+    if not args.data_path:
+        raise SystemExit("--data_path is required")
     cfg = args_to_run_config(args)
     model = biencoder_config(
         num_layers=cfg.model.num_layers,
