@@ -88,7 +88,7 @@ def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
     g.add_argument("--optimizer", default="adam", choices=["adam", "sgd"])
     g.add_argument("--sgd_momentum", type=float, default=0.9)
     g.add_argument("--attention_impl", default="xla",
-                   choices=["xla", "pallas", "ring"])
+                   choices=["xla", "pallas", "ring", "ulysses"])
     g.add_argument("--use_flash_attn", action="store_true",
                    help="ref alias for --attention_impl pallas")
     g.add_argument("--exit_signal_handler", action="store_true",

@@ -31,7 +31,7 @@ GLU_ACTIVATIONS = ("geglu", "swiglu", "reglu", "liglu")
 # "padding": bidirectional with a per-row key padding mask (BERT-style
 # encoders); requires an attention_mask input end-to-end.
 ATTN_MASK_TYPES = ("causal", "bidirectional", "padding")
-ATTENTION_IMPLS = ("xla", "pallas", "ring")
+ATTENTION_IMPLS = ("xla", "pallas", "ring", "ulysses")
 RECOMPUTE_POLICIES = ("none", "selective", "full")
 DTYPES = {"bfloat16": jnp.bfloat16, "float16": jnp.float16, "float32": jnp.float32}
 

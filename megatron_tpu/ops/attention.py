@@ -68,21 +68,32 @@ def attention(
 
     q_offset: absolute position of q[0] (incremental decoding with KV cache).
     """
-    if impl == "ring":
+    if impl in ("ring", "ulysses"):
         # context-parallel exact attention; requires an ambient mesh with a
         # "context" axis (jax.sharding.set_mesh) and no dropout/padding
         can_use = (dropout == 0.0 and padding_mask is None
                    and q.shape[1] == k.shape[1])
         if can_use:
+            if impl == "ulysses":
+                from megatron_tpu.ops.ulysses import ulysses_attention_sharded
+
+                # inner attention runs full-sequence per head shard: use the
+                # flash kernel on TPU or per-device score memory is O(S^2) —
+                # the thing context parallelism was chosen to avoid
+                inner = "pallas" if jax.default_backend() != "cpu" else "xla"
+                return ulysses_attention_sharded(
+                    q, k, v, mesh=None, mask_type=mask_type,
+                    sliding_window=sliding_window, inner_impl=inner)
             from megatron_tpu.ops.ring_attention import ring_attention_sharded
+
             return ring_attention_sharded(
                 q, k, v, mesh=None, mask_type=mask_type,
                 sliding_window=sliding_window)
         if dropout > 0.0 or padding_mask is not None:
             # statically-known conflict: the O(S^2) fallback defeats the
-            # memory bound ring attention was chosen for
+            # memory bound context parallelism was chosen for
             warnings.warn(
-                "attention_impl='ring' is incompatible with attention "
+                f"attention_impl={impl!r} is incompatible with attention "
                 "dropout / padding masks; falling back to the O(S^2) XLA "
                 "path", stacklevel=2)
         # decode steps (q_len != kv_len) fall through silently by design

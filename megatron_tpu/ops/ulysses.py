@@ -1,0 +1,91 @@
+"""Ulysses (all-to-all) sequence/context parallelism.
+
+Beyond reference parity (like ring attention — the reference has no CP at
+all): DeepSpeed-Ulysses-style attention where the sequence axis is
+sharded over "context" everywhere EXCEPT inside attention. Two
+all-to-alls per attention call re-partition [B, S/cp, H, D] into
+[B, S, H/cp, D] (heads scattered, sequence gathered), each device runs
+full-sequence attention for its head subset, and the inverse all-to-all
+restores sequence sharding.
+
+vs ring attention: Ulysses moves Q, K, V and O once each (4 all-to-alls
+of O(S*H*D/cp) per device) instead of rotating K/V cp times, and the
+inner attention is a plain full-sequence kernel (the splash/flash kernel
+on TPU) rather than a blockwise online-softmax loop — simpler and often
+faster at moderate S, but per-device score memory is O(S^2 * H/cp)
+unless the inner kernel is flash, and cp must divide both head counts.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from megatron_tpu.parallel.mesh import AXIS_CONTEXT
+
+
+def ulysses_attention(
+    q: jnp.ndarray,  # [B, S_local, Hq, D] (inside shard_map, context manual)
+    k: jnp.ndarray,  # [B, S_local, Hkv, D]
+    v: jnp.ndarray,
+    axis_name: str = AXIS_CONTEXT,
+    mask_type: str = "causal",
+    sliding_window: Optional[int] = None,
+    inner_impl: str = "xla",
+) -> jnp.ndarray:
+    """All-to-all attention. Requires Hq % cp == 0 and Hkv % cp == 0."""
+    from megatron_tpu.ops.attention import attention
+
+    cp = jax.lax.axis_size(axis_name)
+
+    def scatter_heads(x):  # [B, S/cp, H, D] -> [B, S, H/cp, D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    qg, kg, vg = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    out = attention(qg, kg, vg, mask_type=mask_type,
+                    sliding_window=sliding_window, impl=inner_impl)
+    # [B, S, Hq/cp, D] -> [B, S/cp, Hq, D]
+    return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+
+def ulysses_attention_sharded(
+    q: jnp.ndarray,  # [B, S, Hq, D] global (GSPMD view)
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh=None,
+    mask_type: str = "causal",
+    sliding_window: Optional[int] = None,
+    inner_impl: str = "xla",
+) -> jnp.ndarray:
+    """GSPMD-callable wrapper: context axis manual, everything else auto.
+
+    mesh=None uses the ambient mesh (jax.sharding.set_mesh)."""
+    use_mesh = mesh
+    if use_mesh is None:
+        from jax.sharding import get_abstract_mesh
+
+        use_mesh = get_abstract_mesh()
+    cp = use_mesh.shape.get(AXIS_CONTEXT, 1) if use_mesh is not None else 1
+    hq, hkv = q.shape[2], k.shape[2]
+    if cp > 1 and (hq % cp or hkv % cp):
+        raise ValueError(
+            f"ulysses context parallelism scatters heads over the context "
+            f"axis: cp={cp} must divide both query heads ({hq}) and kv "
+            f"heads ({hkv}) — use ring attention for this head layout")
+    fn = jax.shard_map(
+        lambda q, k, v: ulysses_attention(
+            q, k, v, mask_type=mask_type, sliding_window=sliding_window,
+            inner_impl=inner_impl),
+        mesh=mesh,
+        in_specs=(P(None, AXIS_CONTEXT), P(None, AXIS_CONTEXT),
+                  P(None, AXIS_CONTEXT)),
+        out_specs=P(None, AXIS_CONTEXT),
+        axis_names={AXIS_CONTEXT},
+        check_vma=False,
+    )
+    return fn(q, k, v)
