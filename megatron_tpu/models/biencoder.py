@@ -71,6 +71,32 @@ def biencoder_param_specs(cfg: ModelConfig, shared: bool = False) -> Dict[str, A
     return {"query": tower(), "context": tower()}
 
 
+def load_biencoder_params(
+    cfg: ModelConfig,
+    opt_cfg,
+    load: Optional[str],
+    ict_head_size: int,
+    shared: bool,
+) -> Dict[str, Any]:
+    """Init (PRNGKey(0)) and optionally restore biencoder params — the one
+    config/init/restore recipe shared by the indexer and the ORQA
+    evaluator so their towers can never diverge."""
+    import jax as _jax
+
+    from megatron_tpu.training import checkpointing
+    from megatron_tpu.training.optimizer import init_train_state
+
+    params = biencoder_init_params(cfg, _jax.random.PRNGKey(0),
+                                   ict_head_size=ict_head_size,
+                                   shared=shared)
+    if load:
+        state = init_train_state(opt_cfg, params)
+        state, _, _ = checkpointing.load_checkpoint(
+            load, state, no_load_optim=True)
+        params = state.params
+    return params
+
+
 def embed_text(
     cfg: ModelConfig,
     tower: Dict[str, Any],

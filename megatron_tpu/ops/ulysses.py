@@ -39,8 +39,6 @@ def ulysses_attention(
     """All-to-all attention. Requires Hq % cp == 0 and Hkv % cp == 0."""
     from megatron_tpu.ops.attention import attention
 
-    cp = jax.lax.axis_size(axis_name)
-
     def scatter_heads(x):  # [B, S/cp, H, D] -> [B, S, H/cp, D]
         return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
                                   tiled=True)

@@ -90,10 +90,8 @@ def main(argv=None):
     from megatron_tpu.data.ict_dataset import ICTDataset
     from megatron_tpu.data.indexed_dataset import make_dataset
     from megatron_tpu.models.biencoder import (
-        biencoder_config, biencoder_init_params, biencoder_param_specs,
+        biencoder_config, load_biencoder_params,
     )
-    from megatron_tpu.training import checkpointing
-    from megatron_tpu.training.optimizer import init_train_state
 
     args = parse_args(argv, extra_args_provider=extra)
     if not args.data_path:
@@ -110,14 +108,8 @@ def main(argv=None):
     cfg = dataclasses.replace(cfg, model=model)
 
     shared = args.biencoder_shared_query_context_model
-    params = biencoder_init_params(model, jax.random.PRNGKey(0),
-                                   ict_head_size=args.ict_head_size,
-                                   shared=shared)
-    if cfg.training.load:
-        state = init_train_state(cfg.optimizer, params)
-        state, _, _ = checkpointing.load_checkpoint(
-            cfg.training.load, state, no_load_optim=True)
-        params = state.params
+    params = load_biencoder_params(model, cfg.optimizer, cfg.training.load,
+                                   args.ict_head_size, shared)
     tower = params.get("shared", params.get("context"))
 
     blocks = make_dataset(args.data_path[0])
