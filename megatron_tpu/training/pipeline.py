@@ -121,6 +121,35 @@ def _stage_fn(cfg: ModelConfig, chunk_layers: Any, x: jnp.ndarray,
     return x
 
 
+def vpp_place_indices(L: int, Pn: int, V: int):
+    """(place, inverse) permutations for interleaved layer storage.
+
+    Placed order = (stage, chunk-slot, layer-in-chunk): virtual stage
+    k = c*Pn + s covers canonical layers [k*Lv, (k+1)*Lv) and lands on
+    physical stage s, so sharding the placed leading axis over "pipe"
+    puts each stage's V chunks on its devices. Identity when V == 1.
+
+    Applying `place` per step inside the jitted loss would move
+    ~(V-1)/V of the layer weights across the pipe axis every step (and
+    the scatter transpose every backward); TrainLoop instead stores the
+    training state's layer subtrees in placed order for the whole run
+    (layers_placed=True here) and applies `inverse` only at checkpoint /
+    eval boundaries.
+    """
+    if L % (Pn * V):
+        raise ValueError(
+            f"num_layers={L} not divisible by stages*chunks {Pn}*{V}")
+    Lv = L // (Pn * V)
+    place = np.zeros(L, np.int32)
+    for s in range(Pn):
+        for c in range(V):
+            for j in range(Lv):
+                place[(s * V + c) * Lv + j] = ((c * Pn + s) * Lv) + j
+    inv = np.empty_like(place)
+    inv[place] = np.arange(L, dtype=np.int32)
+    return place, inv
+
+
 def make_pipeline_loss_fn(
     model_cfg: ModelConfig,
     mesh: Mesh,
@@ -130,6 +159,7 @@ def make_pipeline_loss_fn(
     sharder=None,
     num_virtual_chunks: int = 1,
     remat_segment: Optional[int] = None,
+    layers_placed: bool = False,
 ):
     """Returns loss_fn(params, batch, dropout_key) -> (mean_loss, aux).
 
@@ -157,20 +187,7 @@ def make_pipeline_loss_fn(
             f"interleaved schedule needs num_microbatches % num_stages == 0 "
             f"(got {M} % {Pn}; ref schedules.py:22-29)")
 
-    # Round-robin chunk placement: new leading order (stage, chunk-slot,
-    # layer-in-chunk) <- virtual stage k = c*Pn + s covers layers
-    # [k*Lv, (k+1)*Lv). Identity when V == 1.
-    # KNOWN COST (V > 1): the take runs inside the jitted step, so ~(V-1)/V
-    # of the layer weights cross the pipe axis every step (and the scatter
-    # transpose every backward). Storing layer params in placed order —
-    # with the inverse permutation applied at checkpoint/interop
-    # boundaries — would eliminate it; until then interleaving trades
-    # weight traffic for the 1/V bubble reduction.
-    place = np.zeros(L, np.int32)
-    for s in range(Pn):
-        for c in range(V):
-            for j in range(Lv):
-                place[(s * V + c) * Lv + j] = ((c * Pn + s) * Lv) + j
+    place, _ = vpp_place_indices(L, Pn, V)
 
     def loss_fn(params: Dict[str, Any], batch: Dict[str, jnp.ndarray],
                 dropout_key: Optional[jax.Array] = None):
@@ -219,7 +236,7 @@ def make_pipeline_loss_fn(
         key_arg = dropout_key if dropout_on else jax.random.PRNGKey(0)
 
         layers = params["layers"]
-        if V > 1:
+        if V > 1 and not layers_placed:
             layers = jax.tree.map(lambda a: jnp.take(a, place, axis=0), layers)
 
         def pipelined(layers, other, tokens, positions, labels, loss_mask, key):

@@ -14,6 +14,7 @@ batch-size rampup, periodic eval, logging, checkpointing, graceful exit
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import sys
 import time
@@ -36,7 +37,9 @@ from megatron_tpu.training.microbatches import MicroBatchCalculator
 from megatron_tpu.training.optimizer import (
     TrainState, init_train_state, train_state_specs,
 )
-from megatron_tpu.training.pipeline import make_pipeline_loss_fn
+from megatron_tpu.training.pipeline import (
+    make_pipeline_loss_fn, vpp_place_indices,
+)
 from megatron_tpu.training.signal_handler import DistributedSignalHandler
 from megatron_tpu.training.timers import Timers
 from megatron_tpu.training.train_step import make_eval_step, make_train_step
@@ -122,6 +125,17 @@ class TrainLoop:
             run_cfg.optimizer, params,
             use_fp16_scaler=(model_cfg.params_dtype == "float16"))
 
+        # Interleaved pipeline: keep the layer subtrees of the whole
+        # training state in placed (round-robin chunk) order for the run,
+        # so the per-step permutation — ~(V-1)/V of layer weights crossing
+        # the pipe axis each step — disappears. Canonical order is restored
+        # at checkpoint and eval boundaries (_place_state/_unplace below).
+        self._vpp_perms = None
+        vpp = run_cfg.parallel.virtual_pipeline_parallel or 1
+        if self.rt.pp > 1 and vpp > 1:
+            self._vpp_perms = vpp_place_indices(
+                model_cfg.num_layers, self.rt.pp, vpp)
+
         zero1 = run_cfg.optimizer.use_distributed_optimizer
         self.state_specs = train_state_specs(self.specs, params, self.rt.dp,
                                              zero1=zero1)
@@ -136,6 +150,7 @@ class TrainLoop:
 
         if run_cfg.training.load:
             self._load()
+        self.state = self._permute_state(self.state, to_placed=True)
 
         sp = run_cfg.parallel.sequence_parallel
 
@@ -170,6 +185,28 @@ class TrainLoop:
             wandb_name=run_cfg.training.wandb_name,
             config=run_cfg.to_dict())
 
+    # -- placed (interleaved) layer order -----------------------------------
+
+    def _permute_state(self, state, to_placed: bool):
+        """Permute the layer subtrees of every params-like tree in the
+        state between canonical and placed order (identity unless VPP)."""
+        if self._vpp_perms is None:
+            return state
+        idx = self._vpp_perms[0] if to_placed else self._vpp_perms[1]
+
+        def fix(tree):
+            if tree is None or "layers" not in tree:
+                return tree
+            layers = jax.tree.map(lambda a: jnp.take(a, idx, axis=0),
+                                  tree["layers"])
+            return {**tree, "layers": layers}
+
+        out = dataclasses.replace(state, params=fix(state.params),
+                                  master=fix(state.master), mu=fix(state.mu),
+                                  nu=fix(state.nu))
+        # the eager take drops sharding; restore the state placement
+        return jax.device_put(out, self.state_shardings)
+
     # -- checkpoint ---------------------------------------------------------
 
     def _load(self):
@@ -191,8 +228,10 @@ class TrainLoop:
         t = self.cfg.training
         if not t.save:
             return
+        # checkpoints are always canonical layer order (topology-portable)
+        state = self._permute_state(self.state, to_placed=False)
         path = checkpointing.save_checkpoint(
-            t.save, self.state, self.iteration, self.consumed_samples,
+            t.save, state, self.iteration, self.consumed_samples,
             config=self.cfg.to_dict())
         self.log(f"saved checkpoint to {path}")
 
@@ -217,7 +256,9 @@ class TrainLoop:
                     # full recompute = the memory-pressure regime: also
                     # segment the tick scan so live carries stay at the
                     # 1F1B-like ~2*pp bound instead of one per tick
-                    remat_segment=pp if recompute == "full" else None)
+                    remat_segment=pp if recompute == "full" else None,
+                    # the state stores layers in placed order (see __init__)
+                    layers_placed=self._vpp_perms is not None)
             step = make_train_step(
                 self.cfg.model, self.cfg.optimizer, self.cfg.training,
                 num_microbatches=num_microbatches,
@@ -273,12 +314,24 @@ class TrainLoop:
             self.eval_step = jax.jit(es)
         total, count = 0.0, 0
         extras: Dict[str, float] = {}
+        # eval runs the unpipelined loss: restore canonical layer order —
+        # params only (permuting master/mu/nu too would move 4x the bytes)
+        eval_params = self.state.params
+        if self._vpp_perms is not None:
+            inv = self._vpp_perms[1]
+            eval_params = {
+                **eval_params,
+                "layers": jax.tree.map(lambda a: jnp.take(a, inv, axis=0),
+                                       eval_params["layers"]),
+            }
+            eval_params = jax.device_put(
+                eval_params, self.state_shardings.params)
         with jax.sharding.set_mesh(self.rt.mesh):
             for _ in range(eval_iters):
                 batch = next(data_iter, None)
                 if batch is None:
                     break
-                out = self.eval_step(self.state.params, self._put_batch(batch))
+                out = self.eval_step(eval_params, self._put_batch(batch))
                 total += float(out["lm_loss"])
                 for k, v in out.items():
                     if k not in ("lm_loss", "ntokens"):
