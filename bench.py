@@ -45,15 +45,6 @@ def main():
 
     opt_cfg = OptimizerConfig(lr=1e-4, lr_decay_style="constant")
     micro_bs = 4
-    tcfg = TrainingConfig(micro_batch_size=micro_bs, global_batch_size=micro_bs,
-                          recompute_granularity="selective", seed=0)
-
-    params = init_params(cfg, jax.random.PRNGKey(0))
-    state = init_train_state(opt_cfg, params)
-    step = jax.jit(
-        make_train_step(cfg, opt_cfg, tcfg, num_microbatches=1, train_iters=1000),
-        donate_argnums=(0,),
-    )
 
     rng = np.random.default_rng(0)
     batch = {
@@ -62,12 +53,36 @@ def main():
         "loss_mask": jnp.ones((micro_bs, cfg.seq_length), jnp.float32),
     }
 
-    # warmup / compile. NB: sync via host transfer (float()) — on the axon
-    # TPU plugin block_until_ready returns without waiting.
-    state, metrics = step(state, batch)
-    float(metrics["loss"])
-    state, metrics = step(state, batch)
-    float(metrics["loss"])
+    # try no recompute first (fastest when activations fit HBM), fall back
+    # to selective on OOM. Warmup syncs via host transfer (float()) — on
+    # the axon TPU plugin block_until_ready returns without waiting.
+    recompute = None
+    for granularity in ("none", "selective"):
+        tcfg = TrainingConfig(micro_batch_size=micro_bs,
+                              global_batch_size=micro_bs,
+                              recompute_granularity=granularity, seed=0)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        state = init_train_state(opt_cfg, params)
+        step = jax.jit(
+            make_train_step(cfg, opt_cfg, tcfg, num_microbatches=1,
+                            train_iters=1000),
+            donate_argnums=(0,),
+        )
+        try:
+            state, metrics = step(state, batch)
+            float(metrics["loss"])
+            state, metrics = step(state, batch)
+            float(metrics["loss"])
+            recompute = granularity
+            break
+        except Exception as e:  # XlaRuntimeError OOM etc.
+            if "RESOURCE_EXHAUSTED" not in str(e) and "memory" not in str(e).lower():
+                raise
+            # free the failed attempt before the fallback allocates
+            del params, state, step
+            print(f"# recompute={granularity} OOM, retrying", file=sys.stderr)
+    if recompute is None:
+        raise RuntimeError("both recompute granularities OOMed")
 
     iters = 5
     profile_dir = os.environ.get("MEGATRON_TPU_PROFILE_DIR")
@@ -109,6 +124,8 @@ def main():
             "device": str(dev),
             "device_kind": kind,
             "peak_flops_assumed": peak,
+            "recompute": recompute,
+            "attention": "pallas(splash)",
         },
     }))
 

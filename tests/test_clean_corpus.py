@@ -1,0 +1,72 @@
+"""Corpus cleanup + dedup tool (compact counterpart of the reference's
+tools/openwebtext/ pipeline)."""
+
+import json
+
+import numpy as np
+
+from tools.clean_corpus import clean_corpus, clean_text, url_ok
+
+
+def _doc(words, url=None):
+    return {"text": " ".join(words), "url": url}
+
+
+def test_url_blacklist():
+    bl = {"spam.com"}
+    assert url_ok("https://good.org/a", bl)
+    assert not url_ok("https://spam.com/a", bl)
+    assert not url_ok("https://sub.spam.com/a", bl)
+    assert not url_ok("ftp://weird", bl)
+    assert url_ok(None, bl)
+
+
+def test_clean_text_normalizes():
+    assert clean_text("a b   c") == "a b c"
+    assert clean_text("x\n\n\n\n\ny") == "x\n\ny"
+    # control characters stripped
+    assert clean_text("a\x00b\x07c") == "abc"
+
+
+def test_exact_and_near_dedup():
+    rng = np.random.default_rng(0)
+    base = [str(int(x)) for x in rng.integers(0, 1000, 200)]
+    near = list(base)
+    near[3] = "CHANGED"  # one-word edit: still a near-duplicate
+    distinct = [str(int(x)) for x in rng.integers(0, 1000, 200)]
+    docs = [_doc(base), _doc(base), _doc(near), _doc(distinct)]
+    kept, report = clean_corpus(docs, min_words=10)
+    assert report["exact_dup"] == 1
+    assert report["near_dup"] == 1
+    assert report["kept"] == 2
+
+
+def test_short_and_blacklisted_dropped(tmp_path):
+    from tools import clean_corpus as cc
+
+    rng = np.random.default_rng(1)
+    long_words = [str(int(x)) for x in rng.integers(0, 1000, 150)]
+    docs = [
+        _doc(long_words, "https://ok.org/1"),
+        _doc(["too", "short"], "https://ok.org/2"),
+        _doc(long_words[::-1], "https://bad.net/3"),
+    ]
+    inp = tmp_path / "in.jsonl"
+    inp.write_text("".join(json.dumps(d) + "\n" for d in docs))
+    bl = tmp_path / "bl.txt"
+    bl.write_text("bad.net\n")
+    out = tmp_path / "out.jsonl"
+    report = cc.main(["--input", str(inp), "--output", str(out),
+                      "--blacklist", str(bl), "--min_words", "100"])
+    assert report == {"total": 3, "bad_url": 1, "too_short": 1,
+                      "exact_dup": 0, "near_dup": 0, "kept": 1}
+    lines = out.read_text().splitlines()
+    assert len(lines) == 1 and json.loads(lines[0])["url"] == "https://ok.org/1"
+
+
+def test_url_blacklist_www_and_port():
+    bl = {"weather.com", "spam.com"}
+    assert not url_ok("https://www.weather.com/x", bl)   # www prefix
+    assert not url_ok("http://spam.com:80/a", bl)        # explicit port
+    assert not url_ok("http://user:pw@spam.com/a", bl)   # userinfo
+    assert url_ok("https://wa.com/x", {"a.com"})         # no prefix mangling

@@ -1,0 +1,183 @@
+#!/usr/bin/env python
+"""Corpus cleanup + near-duplicate removal for jsonl pretraining data.
+
+Compact equivalent of the reference's tools/openwebtext/ pipeline
+(blacklist_urls.py, cleanup_dataset.py, find_duplicates.py,
+remove_group_duplicates.py, filter_ngrams.py — ~2k LoC of scripts glued
+by hand): one tool that
+
+  1. drops documents from blacklisted / malformed URLs,
+  2. fixes mojibake-ish whitespace artifacts and normalizes unicode,
+  3. drops documents shorter than --min_chars / --min_words,
+  4. removes exact duplicates (content hash) and near-duplicates
+     (MinHash over word shingles with banded LSH, the same scheme the
+     reference uses via the external LSH package),
+  5. writes the surviving jsonl + a report.
+
+  python tools/clean_corpus.py --input raw.jsonl --output clean.jsonl \
+      --blacklist bad_domains.txt --min_words 128
+"""
+
+import argparse
+import hashlib
+import json
+import re
+import sys
+import unicodedata
+from typing import Iterable, List, Optional, Set
+from urllib.parse import urlparse
+
+# MinHash parameters: 10 bands x 13 rows approximates a ~0.7 jaccard
+# threshold (the reference's LSH settings)
+_NUM_PERM = 130
+_BANDS = 10
+_ROWS = _NUM_PERM // _BANDS
+
+
+def _hash64(data: bytes) -> int:
+    return int.from_bytes(hashlib.blake2b(data, digest_size=8).digest(), "big")
+
+
+class MinHasher:
+    """Multiply-shift hashing in uint64 (wraparound is the modulus)."""
+
+    def __init__(self, seed: int = 1234):
+        import numpy as np
+
+        rng = np.random.RandomState(seed)
+        # odd multipliers for full-period multiply-shift
+        self.a = (rng.randint(0, 2**63 - 1, _NUM_PERM).astype(np.uint64)
+                  * np.uint64(2) + np.uint64(1))
+        self.b = rng.randint(0, 2**63 - 1, _NUM_PERM).astype(np.uint64)
+
+    def signature(self, shingles: Set[int]):
+        import numpy as np
+
+        if not shingles:
+            return np.full(_NUM_PERM, np.iinfo(np.uint64).max, np.uint64)
+        h = np.asarray(sorted(shingles), np.uint64)[:, None]
+        with np.errstate(over="ignore"):
+            vals = h * self.a[None, :] + self.b[None, :]
+        return vals.min(axis=0)
+
+
+def shingles(text: str, k: int = 5) -> Set[int]:
+    words = text.split()
+    return {_hash64(" ".join(words[i:i + k]).encode())
+            for i in range(max(len(words) - k + 1, 1))}
+
+
+def clean_text(text: str) -> str:
+    """Unicode normalize + collapse whitespace (the reference runs ftfy;
+    NFC + control-char stripping covers the common artifacts without the
+    dependency)."""
+    text = unicodedata.normalize("NFC", text)
+    text = "".join(c for c in text
+                   if unicodedata.category(c)[0] != "C" or c in "\n\t")
+    text = re.sub(r"[ \t]+", " ", text)
+    text = re.sub(r"\n{3,}", "\n\n", text)
+    return text.strip()
+
+
+def url_ok(url: Optional[str], blacklist: Set[str]) -> bool:
+    """ref blacklist_urls.py: domain blacklist + scheme sanity."""
+    if url is None:
+        return True
+    try:
+        parsed = urlparse(url)
+    except ValueError:
+        return False
+    if parsed.scheme not in ("http", "https", ""):
+        return False
+    # hostname lowercases and drops userinfo/port; then strip one www.
+    host = (parsed.hostname or "").removeprefix("www.")
+    return not any(host == b or host.endswith("." + b) for b in blacklist)
+
+
+def clean_corpus(
+    docs: Iterable[dict],
+    blacklist: Set[str] = frozenset(),
+    min_chars: int = 0,
+    min_words: int = 128,
+    dedup: bool = True,
+) -> tuple:
+    """Returns (kept_docs, report dict)."""
+    hasher = MinHasher()
+    seen_exact: Set[bytes] = set()
+    lsh_buckets: List[Set[bytes]] = [set() for _ in range(_BANDS)]
+    kept: List[dict] = []
+    report = {"total": 0, "bad_url": 0, "too_short": 0, "exact_dup": 0,
+              "near_dup": 0, "kept": 0}
+
+    for doc in docs:
+        report["total"] += 1
+        text = doc.get("text", "")
+        if not url_ok(doc.get("url"), blacklist):
+            report["bad_url"] += 1
+            continue
+        text = clean_text(text)
+        if len(text) < min_chars or len(text.split()) < min_words:
+            report["too_short"] += 1
+            continue
+        digest = hashlib.blake2b(text.encode(), digest_size=16).digest()
+        if digest in seen_exact:
+            report["exact_dup"] += 1
+            continue
+        seen_exact.add(digest)
+
+        if dedup:
+            sig = hasher.signature(shingles(text))
+            is_dup = False
+            keys = []
+            for band in range(_BANDS):
+                key = hashlib.blake2b(
+                    sig[band * _ROWS:(band + 1) * _ROWS].tobytes(),
+                    digest_size=8).digest()
+                keys.append(key)
+                if key in lsh_buckets[band]:
+                    is_dup = True
+            if is_dup:
+                report["near_dup"] += 1
+                continue
+            for band, key in enumerate(keys):
+                lsh_buckets[band].add(key)
+
+        kept.append({**doc, "text": text})
+        report["kept"] += 1
+    return kept, report
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--input", required=True)
+    p.add_argument("--output", required=True)
+    p.add_argument("--blacklist", default=None,
+                   help="file with one blacklisted domain per line")
+    p.add_argument("--min_chars", type=int, default=0)
+    p.add_argument("--min_words", type=int, default=128)
+    p.add_argument("--no_dedup", action="store_true")
+    args = p.parse_args(argv)
+
+    blacklist = set()
+    if args.blacklist:
+        with open(args.blacklist) as f:
+            blacklist = {ln.strip().lower() for ln in f if ln.strip()}
+
+    def docs():
+        with open(args.input) as f:
+            for line in f:
+                if line.strip():
+                    yield json.loads(line)
+
+    kept, report = clean_corpus(
+        docs(), blacklist=blacklist, min_chars=args.min_chars,
+        min_words=args.min_words, dedup=not args.no_dedup)
+    with open(args.output, "w") as f:
+        for doc in kept:
+            f.write(json.dumps(doc) + "\n")
+    print(json.dumps(report))
+    return report
+
+
+if __name__ == "__main__":
+    main()
