@@ -142,6 +142,10 @@ class TrainLoop:
         self.state_shardings = jax.tree.map(
             lambda s: NamedSharding(self.rt.mesh, s), self.state_specs,
             is_leaf=lambda s: isinstance(s, P))
+        # place the fresh state on its training shardings — with ZeRO-1 the
+        # optimizer moments are data-sharded, which param-derived init does
+        # not produce
+        self.state = jax.device_put(self.state, self.state_shardings)
         self.batch_sharding = NamedSharding(self.rt.mesh, batch_spec())
 
         self.calc = MicroBatchCalculator.from_config(run_cfg.training, self.rt.dp)
@@ -267,10 +271,14 @@ class TrainLoop:
                 loss_fn=self.loss_fn,
                 pipeline_loss_fn=pp_loss_fn)
             # batch leaves were placed by _put_batch (rank-aware specs);
-            # let jit infer their shardings from the arguments
+            # let jit infer their shardings from the arguments. The OUTPUT
+            # state is pinned to the same shardings as the input — without
+            # this, XLA may emit e.g. data-sharded masters from a ZeRO-1
+            # step and the next call rejects its own output as input
             self._step_cache[num_microbatches] = jax.jit(
                 step,
                 in_shardings=(self.state_shardings, None),
+                out_shardings=(self.state_shardings, None),
                 donate_argnums=(0,))
         return self._step_cache[num_microbatches]
 

@@ -70,3 +70,27 @@ def test_url_blacklist_www_and_port():
     assert not url_ok("http://spam.com:80/a", bl)        # explicit port
     assert not url_ok("http://user:pw@spam.com/a", bl)   # userinfo
     assert url_ok("https://wa.com/x", {"a.com"})         # no prefix mangling
+
+
+def test_blacklist_edge_cases():
+    # scheme-less URL still hits the blacklist
+    assert not url_ok("spam.com/article", {"spam.com"})
+    # ZWNJ (Cf) survives cleanup; NUL (Cc) does not
+    assert clean_text("a‌b\x00c") == "a‌bc"
+
+
+def test_blacklist_file_with_www(tmp_path):
+    import json as _json
+
+    from tools import clean_corpus as cc
+
+    words = [str(i) for i in range(150)]
+    inp = tmp_path / "in.jsonl"
+    inp.write_text(_json.dumps(
+        {"text": " ".join(words), "url": "https://spam.com/x"}) + "\n")
+    bl = tmp_path / "bl.txt"
+    bl.write_text("www.spam.com\n")  # published blacklists often have www.
+    out = tmp_path / "out.jsonl"
+    report = cc.main(["--input", str(inp), "--output", str(out),
+                      "--blacklist", str(bl), "--min_words", "100"])
+    assert report["bad_url"] == 1 and report["kept"] == 0

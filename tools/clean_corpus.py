@@ -27,10 +27,11 @@ import unicodedata
 from typing import Iterable, List, Optional, Set
 from urllib.parse import urlparse
 
-# MinHash parameters: 10 bands x 13 rows approximates a ~0.7 jaccard
-# threshold (the reference's LSH settings)
+# MinHash parameters: 26 bands x 5 rows -> 50%-detection threshold
+# (1/26)^(1/5) ~= 0.52 with a steep ramp (~97% detection at jaccard 0.7),
+# matching the reference pipeline's ~0.7 dedup target
 _NUM_PERM = 130
-_BANDS = 10
+_BANDS = 26
 _ROWS = _NUM_PERM // _BANDS
 
 
@@ -72,8 +73,10 @@ def clean_text(text: str) -> str:
     NFC + control-char stripping covers the common artifacts without the
     dependency)."""
     text = unicodedata.normalize("NFC", text)
+    # strip only Cc controls: Cf format chars (ZWNJ/ZWJ, bidi marks) are
+    # meaningful in Persian/Indic/emoji text
     text = "".join(c for c in text
-                   if unicodedata.category(c)[0] != "C" or c in "\n\t")
+                   if unicodedata.category(c) != "Cc" or c in "\n\t")
     text = re.sub(r"[ \t]+", " ", text)
     text = re.sub(r"\n{3,}", "\n\n", text)
     return text.strip()
@@ -85,29 +88,36 @@ def url_ok(url: Optional[str], blacklist: Set[str]) -> bool:
         return True
     try:
         parsed = urlparse(url)
+        if not parsed.netloc and parsed.path:
+            # scheme-less "spam.com/x": reparse so the host is visible
+            parsed = urlparse("//" + url)
     except ValueError:
         return False
     if parsed.scheme not in ("http", "https", ""):
         return False
     # hostname lowercases and drops userinfo/port; then strip one www.
     host = (parsed.hostname or "").removeprefix("www.")
+    if not host:
+        return False  # a URL string with no parsable host is suspect
     return not any(host == b or host.endswith("." + b) for b in blacklist)
 
 
-def clean_corpus(
+def iter_clean(
     docs: Iterable[dict],
+    report: dict,
     blacklist: Set[str] = frozenset(),
     min_chars: int = 0,
     min_words: int = 128,
     dedup: bool = True,
-) -> tuple:
-    """Returns (kept_docs, report dict)."""
+) -> Iterable[dict]:
+    """Stream surviving docs; only the dedup state (hash set + band keys)
+    stays resident, so corpus size is unbounded. `report` fills as the
+    stream is consumed."""
     hasher = MinHasher()
     seen_exact: Set[bytes] = set()
     lsh_buckets: List[Set[bytes]] = [set() for _ in range(_BANDS)]
-    kept: List[dict] = []
-    report = {"total": 0, "bad_url": 0, "too_short": 0, "exact_dup": 0,
-              "near_dup": 0, "kept": 0}
+    report.update({"total": 0, "bad_url": 0, "too_short": 0, "exact_dup": 0,
+                   "near_dup": 0, "kept": 0})
 
     for doc in docs:
         report["total"] += 1
@@ -142,8 +152,14 @@ def clean_corpus(
             for band, key in enumerate(keys):
                 lsh_buckets[band].add(key)
 
-        kept.append({**doc, "text": text})
         report["kept"] += 1
+        yield {**doc, "text": text}
+
+
+def clean_corpus(docs, **kw) -> tuple:
+    """In-memory convenience wrapper: returns (kept_docs, report)."""
+    report: dict = {}
+    kept = list(iter_clean(docs, report, **kw))
     return kept, report
 
 
@@ -161,7 +177,8 @@ def main(argv=None):
     blacklist = set()
     if args.blacklist:
         with open(args.blacklist) as f:
-            blacklist = {ln.strip().lower() for ln in f if ln.strip()}
+            blacklist = {ln.strip().lower().removeprefix("www.")
+                         for ln in f if ln.strip()}
 
     def docs():
         with open(args.input) as f:
@@ -169,11 +186,12 @@ def main(argv=None):
                 if line.strip():
                     yield json.loads(line)
 
-    kept, report = clean_corpus(
-        docs(), blacklist=blacklist, min_chars=args.min_chars,
-        min_words=args.min_words, dedup=not args.no_dedup)
+    report: dict = {}
     with open(args.output, "w") as f:
-        for doc in kept:
+        for doc in iter_clean(docs(), report, blacklist=blacklist,
+                              min_chars=args.min_chars,
+                              min_words=args.min_words,
+                              dedup=not args.no_dedup):
             f.write(json.dumps(doc) + "\n")
     print(json.dumps(report))
     return report
