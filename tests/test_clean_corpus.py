@@ -94,3 +94,22 @@ def test_blacklist_file_with_www(tmp_path):
     report = cc.main(["--input", str(inp), "--output", str(out),
                       "--blacklist", str(bl), "--min_words", "100"])
     assert report["bad_url"] == 1 and report["kept"] == 0
+
+
+def test_surrogates_and_weird_schemes():
+    # lone surrogate (what json.loads yields for \ud800) must not crash
+    kept, report = clean_corpus(
+        [{"text": "x \ud800 " + " ".join(str(i) for i in range(150))}],
+        min_words=100)
+    assert report["kept"] == 1
+    assert "\ud800" not in kept[0]["text"]
+    # non-http schemes stay rejected; host:port without scheme still matches
+    assert not url_ok("javascript:alert(1)", set())
+    assert not url_ok("mailto:a@spam.com", set())
+    assert not url_ok("spam.com:8080/x", {"spam.com"})
+    # library callers get www-normalized blacklists too
+    _, rep = clean_corpus(
+        [{"text": " ".join(str(i) for i in range(150)),
+          "url": "https://spam.com/x"}],
+        blacklist={"www.spam.com"}, min_words=100)
+    assert rep["bad_url"] == 1

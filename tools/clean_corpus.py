@@ -73,10 +73,13 @@ def clean_text(text: str) -> str:
     NFC + control-char stripping covers the common artifacts without the
     dependency)."""
     text = unicodedata.normalize("NFC", text)
-    # strip only Cc controls: Cf format chars (ZWNJ/ZWJ, bidi marks) are
-    # meaningful in Persian/Indic/emoji text
+    # strip Cc controls and Cs lone surrogates (json.loads emits them
+    # verbatim from \ud800-style escapes; they crash utf-8 encoding later)
+    # but KEEP Cf format chars (ZWNJ/ZWJ, bidi marks) — meaningful in
+    # Persian/Indic/emoji text
     text = "".join(c for c in text
-                   if unicodedata.category(c) != "Cc" or c in "\n\t")
+                   if unicodedata.category(c) not in ("Cc", "Cs")
+                   or c in "\n\t")
     text = re.sub(r"[ \t]+", " ", text)
     text = re.sub(r"\n{3,}", "\n\n", text)
     return text.strip()
@@ -89,8 +92,14 @@ def url_ok(url: Optional[str], blacklist: Set[str]) -> bool:
     try:
         parsed = urlparse(url)
         if not parsed.netloc and parsed.path:
-            # scheme-less "spam.com/x": reparse so the host is visible
-            parsed = urlparse("//" + url)
+            if not parsed.scheme:
+                # scheme-less "spam.com/x": reparse so the host is visible
+                parsed = urlparse("//" + url)
+            elif "." in parsed.scheme:
+                # "spam.com:8080/x" parses as scheme="spam.com"; real
+                # schemes (javascript:, mailto:, data:) have no dot and
+                # keep falling through to the scheme sanity check
+                parsed = urlparse("//" + url)
     except ValueError:
         return False
     if parsed.scheme not in ("http", "https", ""):
@@ -113,6 +122,8 @@ def iter_clean(
     """Stream surviving docs; only the dedup state (hash set + band keys)
     stays resident, so corpus size is unbounded. `report` fills as the
     stream is consumed."""
+    # normalize here so library callers get the same matching as the CLI
+    blacklist = {b.lower().removeprefix("www.") for b in blacklist}
     hasher = MinHasher()
     seen_exact: Set[bytes] = set()
     lsh_buckets: List[Set[bytes]] = [set() for _ in range(_BANDS)]
