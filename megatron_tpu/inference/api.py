@@ -54,9 +54,11 @@ def generate_and_post_process(
     add_BOS: bool = False,
     return_output_log_probs: bool = False,
     random_seed: int = 0,
+    forward_fn=None,
 ):
     """(texts, segments, logprobs, tokens) like the reference's
-    generate_and_post_process (api.py:19-90)."""
+    generate_and_post_process (api.py:19-90). forward_fn plugs in the
+    pipelined pp>1 forward (inference/pipelined.py)."""
     if tokens_to_generate < 0:
         raise ValueError("tokens_to_generate must be >= 0")
     prompt_tokens, lengths = tokenize_prompts(tokenizer, prompts,
@@ -72,7 +74,7 @@ def generate_and_post_process(
         max_new_tokens=tokens_to_generate,
         temperature=temperature, top_k=top_k_sampling, top_p=top_p_sampling,
         vocab_size=tokenizer.vocab_size, eod=tokenizer.eod, seed=random_seed,
-        want_logprobs=return_output_log_probs)
+        want_logprobs=return_output_log_probs, forward_fn=forward_fn)
 
     texts, segments = [], []
     for row, end in zip(out.tokens, out.lengths):

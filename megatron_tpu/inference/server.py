@@ -16,7 +16,10 @@ lock serializes requests like the reference's Flask lock.
 from __future__ import annotations
 
 import json
+import contextlib
 import threading
+
+import jax
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
 
@@ -30,11 +33,21 @@ MAX_PROMPTS = 128
 
 
 class GenerationService:
-    def __init__(self, cfg: ModelConfig, params: Any, tokenizer):
+    def __init__(self, cfg: ModelConfig, params: Any, tokenizer,
+                 mesh=None, forward_fn=None):
+        """mesh + forward_fn serve sharded models: the mesh becomes
+        ambient around generation (GSPMD handles tp/cp), forward_fn is the
+        pp>1 pipelined forward (ref ForwardStep, forward_step.py:45-204)."""
         self.cfg = cfg
         self.params = params
         self.tokenizer = tokenizer
+        self.mesh = mesh
+        self.forward_fn = forward_fn
         self.lock = threading.Lock()
+
+    def _mesh_scope(self):
+        return (jax.sharding.set_mesh(self.mesh) if self.mesh is not None
+                else contextlib.nullcontext())
 
     def handle(self, req: dict) -> dict:
         prompts = req.get("prompts")
@@ -48,8 +61,12 @@ class GenerationService:
         if not 0 <= n <= MAX_TOKENS_TO_GENERATE:
             raise ValueError(f"tokens_to_generate in [0, {MAX_TOKENS_TO_GENERATE}]")
 
-        with self.lock:
+        with self.lock, self._mesh_scope():
             if req.get("beam_width"):
+                if self.forward_fn is not None:
+                    raise ValueError(
+                        "beam search is not supported on pipelined (pp>1) "
+                        "serving; use sampling or serve at pp=1")
                 texts, segments, scores = beam_search_and_post_process(
                     self.cfg, self.params, self.tokenizer, prompts,
                     tokens_to_generate=n,
@@ -66,7 +83,8 @@ class GenerationService:
                 top_p_sampling=float(req.get("top_p", 0.0)),
                 add_BOS=bool(req.get("add_BOS", False)),
                 return_output_log_probs=bool(req.get("logprobs", False)),
-                random_seed=int(req.get("random_seed", 0)))
+                random_seed=int(req.get("random_seed", 0)),
+                forward_fn=self.forward_fn)
             out = {"text": texts, "segments": segments}
             if logprobs is not None:
                 out["logprobs"] = [list(map(float, row)) for row in logprobs]
@@ -103,8 +121,10 @@ def make_handler(service: GenerationService):
 
 
 def run_server(cfg: ModelConfig, params: Any, tokenizer,
-               host: str = "0.0.0.0", port: int = 5000) -> None:
-    service = GenerationService(cfg, params, tokenizer)
+               host: str = "0.0.0.0", port: int = 5000,
+               mesh=None, forward_fn=None) -> None:
+    service = GenerationService(cfg, params, tokenizer, mesh=mesh,
+                                forward_fn=forward_fn)
     server = ThreadingHTTPServer((host, port), make_handler(service))
     print(f"serving generation API on http://{host}:{port}/api")
     server.serve_forever()

@@ -188,8 +188,8 @@ def main(argv=None):
     blacklist = set()
     if args.blacklist:
         with open(args.blacklist) as f:
-            blacklist = {ln.strip().lower().removeprefix("www.")
-                         for ln in f if ln.strip()}
+            # normalization (lower/www.) happens inside iter_clean
+            blacklist = {ln.strip() for ln in f if ln.strip()}
 
     def docs():
         with open(args.input) as f:

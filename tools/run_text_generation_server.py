@@ -49,7 +49,26 @@ def main(argv=None):
     else:
         print("WARNING: serving randomly initialized weights (no --load)")
 
-    run_server(cfg.model, params, tokenizer, host=args.host, port=args.port)
+    # sharded serving: build the mesh, shard params, and (for pp>1) use the
+    # pipelined forward (ref run_text_generation_server's multi-rank loop)
+    mesh = forward_fn = None
+    par = cfg.parallel
+    if par.tensor_parallel * par.pipeline_parallel * par.context_parallel > 1:
+        from megatron_tpu.inference.pipelined import make_pipelined_lm_forward
+        from megatron_tpu.models.params import param_specs
+        from megatron_tpu.parallel.mesh import build_mesh
+        from megatron_tpu.parallel.sharding import shard_tree
+
+        rt = build_mesh(par)
+        params = shard_tree(rt, params, param_specs(cfg.model))
+        mesh = rt.mesh
+        if rt.pp > 1:
+            forward_fn = make_pipelined_lm_forward(cfg.model, rt.mesh, rt.pp)
+        print(f"serving sharded: mesh={dict(rt.mesh.shape)}"
+              + (" (pipelined forward)" if forward_fn else ""))
+
+    run_server(cfg.model, params, tokenizer, host=args.host, port=args.port,
+               mesh=mesh, forward_fn=forward_fn)
 
 
 if __name__ == "__main__":
