@@ -303,3 +303,31 @@ def test_server_http_roundtrip_sharded_pipelined():
         assert ei.value.code == 400
     finally:
         server.shutdown()
+
+
+def test_zeroshot_wikitext_adjusted_ppl(tmp_path):
+    """--task wikitext reports word-level adjusted perplexity with the
+    reference's token-ratio normalization (zeroshot_gpt/evaluate.py)."""
+    import subprocess
+    import sys
+
+    import os
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rng = np.random.default_rng(0)
+    text = " ".join(str(int(x)) for x in rng.integers(0, 60, 400))
+    (tmp_path / "wiki.txt").write_text(text)
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MEGATRON_TPU_FORCE_PLATFORM"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/evaluate_zeroshot.py"),
+         "--task", "wikitext", "--text", str(tmp_path / "wiki.txt"),
+         "--num_layers", "2", "--hidden_size", "32",
+         "--num_attention_heads", "4", "--seq_length", "32",
+         "--vocab_size", "64", "--fp32", "--tokenizer_type", "null"],
+        env=env, capture_output=True, text=True, cwd=REPO, timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:]
+    res = json.loads(out.stdout.strip().splitlines()[-1])
+    assert "adjusted_ppl" in res and res["adjusted_ppl"] > 0
+    assert abs(res["token_ratio"] - 1.0) < 0.05  # null tokenizer: ~1 tok/word

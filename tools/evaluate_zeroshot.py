@@ -136,24 +136,42 @@ def main(argv=None):
     model_cfg, params = _load_model(args)
 
     if args.task in ("wikitext", "ppl"):
+        import math
+
         import numpy as np
 
+        num_original_tokens = None
         if args.tokens:
             stream = np.load(args.tokens)
         elif args.text:
             with open(args.text, encoding="utf-8") as f:
-                stream = np.asarray(tokenizer.tokenize(f.read()))
+                raw = f.read()
+            stream = np.asarray(tokenizer.tokenize(raw))
+            num_original_tokens = len(raw.split())
         elif args.jsonl:
             parts = []
+            num_original_tokens = 0
             with open(args.jsonl, encoding="utf-8") as f:
                 for line in f:
                     if line.strip():
-                        parts.extend(tokenizer.tokenize(json.loads(line)["text"]))
+                        text = json.loads(line)["text"]
+                        parts.extend(tokenizer.tokenize(text))
                         parts.append(tokenizer.eod)
+                        num_original_tokens += len(text.split())
             stream = np.asarray(parts)
         else:
             raise SystemExit("need --text, --jsonl or --tokens")
         out = eval_perplexity(model_cfg, params, stream, batch=args.eval_batch)
+        if args.task == "wikitext" and num_original_tokens:
+            # word-level adjusted ppl: exp(loss * tokenized/original ratio)
+            # (ref tasks/zeroshot_gpt/evaluate.py:152-160). The full-stream
+            # ratio stays correct even though eval drops the sub-stride
+            # tail: evaluated nats (loss * N_eval) over evaluated words
+            # (W * N_eval / N_stream) reduces to loss * N_stream / W.
+            ratio = (len(stream) - 1) / max(num_original_tokens - 1, 1)
+            out["adjusted_ppl"] = math.exp(
+                min(out["lm_loss"] * ratio, 20.0))
+            out["token_ratio"] = ratio
     else:
         if not args.jsonl:
             raise SystemExit("lambada needs --jsonl")
