@@ -39,6 +39,12 @@ def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
     g.add_argument("--rope_scaling_factor", type=float, default=1.0)
     g.add_argument("--layernorm_epsilon", type=float, default=1e-5)
     g.add_argument("--use_rms_norm", action="store_true")
+    g.add_argument("--use_post_ln", action="store_true",
+                   help="post-LN layer convention (no pre-norm; per-layer "
+                        "output norm; no final stack norm)")
+    g.add_argument("--apply_residual_connection_post_layernorm",
+                   action="store_true",
+                   help="take residuals from the LN output (ref semantics)")
     g.add_argument("--glu_activation", default=None,
                    choices=["swiglu", "geglu", "reglu", "liglu"])
     g.add_argument("--parallel_attn", action="store_true")
@@ -307,6 +313,8 @@ def args_to_run_config(args) -> RunConfig:
             use_bias_qkv=args.use_bias,
             tie_embed_logits=args.tie_embed_logits,
             sliding_window_size=args.sliding_window_size,
+            use_post_ln=args.use_post_ln,
+            apply_residual_post_ln=args.apply_residual_connection_post_layernorm,
             hidden_dropout=args.hidden_dropout,
             attention_dropout=args.attention_dropout,
             lima_dropout=args.lima_dropout,

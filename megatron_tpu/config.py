@@ -88,6 +88,12 @@ class ModelConfig:
     # second dedicated mlp layernorm (Falcon-40B parallel_layernorm).
     parallel_attn: bool = False
     parallel_layernorm: bool = False
+    # post-LN layer convention (ref --use_post_ln): no pre-norm, each layer
+    # ends with its own LN (reusing the ln1 slot), no final stack norm
+    use_post_ln: bool = False
+    # residual taken from the LN output instead of the LN input
+    # (ref --apply_residual_connection_post_layernorm)
+    apply_residual_post_ln: bool = False
     # post-attention norm applied before mlp (standard pre-LN stack)
 
     # biases (llama/falcon: none; gpt: all)
@@ -167,6 +173,8 @@ class ModelConfig:
             raise ValueError(f"bad attn_mask_type {self.attn_mask_type}")
         if self.attention_impl not in ATTENTION_IMPLS:
             raise ValueError(f"bad attention_impl {self.attention_impl}")
+        if self.use_post_ln and self.parallel_attn:
+            raise ValueError("use_post_ln is incompatible with parallel_attn")
         if self.hidden_size % self.num_attention_heads and self.kv_channels is None:
             raise ValueError("num_attention_heads must divide hidden_size")
         if self.num_attention_heads % self.n_kv_heads:

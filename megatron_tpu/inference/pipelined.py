@@ -79,10 +79,13 @@ def make_pipelined_lm_forward(cfg: ModelConfig, mesh: Mesh, num_stages: int):
                 active, compute, lambda a: a, (state, ck, cv))
 
             def mk_logits(_):
-                h = norm_forward(cfg.normalization, state2,
-                                 params_local["final_ln"]["scale"],
-                                 params_local["final_ln"].get("bias"),
-                                 cfg.layernorm_epsilon)
+                if cfg.use_post_ln:  # post-LN layers end with their own norm
+                    h = state2
+                else:
+                    h = norm_forward(cfg.normalization, state2,
+                                     params_local["final_ln"]["scale"],
+                                     params_local["final_ln"].get("bias"),
+                                     cfg.layernorm_epsilon)
                 return lm_logits(cfg, params_local, h).astype(jnp.float32)
 
             logits = jax.lax.cond(active & (stage == Pn - 1), mk_logits,

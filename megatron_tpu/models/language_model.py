@@ -148,8 +148,10 @@ def lm_forward(
     xs = (params["layers"], rates, layer_idx, kv_caches)
     x, new_caches = jax.lax.scan(body, x, xs)
 
-    x = norm_forward(cfg.normalization, x, params["final_ln"]["scale"],
-                     params["final_ln"].get("bias"), cfg.layernorm_epsilon)
+    if not cfg.use_post_ln:  # post-LN layers carry their own output norm
+        x = norm_forward(cfg.normalization, x, params["final_ln"]["scale"],
+                         params["final_ln"].get("bias"),
+                         cfg.layernorm_epsilon)
     if return_hidden:
         return x
 

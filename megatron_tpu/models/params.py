@@ -83,9 +83,10 @@ def _defs(cfg: ModelConfig) -> Dict[str, Any]:
         d["layers/mlp/b_in"] = ((L, Fin), P(AXIS_PIPE, AXIS_TENSOR), _ZEROS)
         d["layers/mlp/b_out"] = ((L, h), P(AXIS_PIPE, None), _ZEROS)
 
-    d["final_ln/scale"] = ((h,), P(None), _ONES)
-    if ln_bias:
-        d["final_ln/bias"] = ((h,), P(None), _ZEROS)
+    if not cfg.use_post_ln:  # post-LN layers carry their own output norm
+        d["final_ln/scale"] = ((h,), P(None), _ONES)
+        if ln_bias:
+            d["final_ln/bias"] = ((h,), P(None), _ZEROS)
     if not cfg.tie_embed_logits:
         d["lm_head/w"] = ((h, V), P(None, AXIS_TENSOR), _NORMAL)
     if cfg.bert_binary_head:

@@ -144,7 +144,9 @@ def block_forward(
         k_attn_drop = k_hidden1 = k_hidden2 = None
     rate = cfg.hidden_dropout if hidden_dropout_rate is None else hidden_dropout_rate
 
-    normed = _norm(cfg, lp["ln1"], x)
+    # post-LN (ref --use_post_ln): no pre-norm; the layer ends with its own
+    # LN, reusing the ln1 parameter slot as the output norm
+    normed = x if cfg.use_post_ln else _norm(cfg, lp["ln1"], x)
     attn_out, kv_cache = attention_block(
         cfg, lp["attn"], normed, rope, positions,
         attn_dropout_key=k_attn_drop if cfg.attention_dropout > 0 else None,
@@ -159,13 +161,20 @@ def block_forward(
         mlp_in = _norm(cfg, lp["ln_mlp"], x) if cfg.parallel_layernorm else normed
         mlp_out = mlp_block(cfg, lp["mlp"], mlp_in)
         mlp_out = _dropout(mlp_out, rate, k_hidden2 if cfg.hidden_dropout > 0 else None)
-        y = x + attn_out + mlp_out
+        res = normed if cfg.apply_residual_post_ln else x
+        y = res + attn_out + mlp_out
     else:
-        y = x + attn_out
+        # residual from the LN output with --apply_residual_connection_
+        # post_layernorm (ref transformer.py:795-799)
+        res1 = normed if cfg.apply_residual_post_ln else x
+        y = res1 + attn_out
         y = sharder(y, "residual")
         normed2 = _norm(cfg, lp["ln2"], y)
         mlp_out = mlp_block(cfg, lp["mlp"], normed2)
         mlp_out = _dropout(mlp_out, rate, k_hidden2 if cfg.hidden_dropout > 0 else None)
-        y = y + mlp_out
+        res2 = normed2 if cfg.apply_residual_post_ln else y
+        y = res2 + mlp_out
+        if cfg.use_post_ln:
+            y = _norm(cfg, lp["ln1"], y)
     y = sharder(y, "residual")
     return y, kv_cache

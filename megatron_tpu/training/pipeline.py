@@ -285,10 +285,13 @@ def make_pipeline_loss_fn(
                                 sharder=sharder)
 
                 def with_loss(_):
-                    h = norm_forward(model_cfg.normalization, out,
-                                     params_local["final_ln"]["scale"],
-                                     params_local["final_ln"].get("bias"),
-                                     model_cfg.layernorm_epsilon)
+                    if model_cfg.use_post_ln:
+                        h = out  # post-LN layers end with their own norm
+                    else:
+                        h = norm_forward(model_cfg.normalization, out,
+                                         params_local["final_ln"]["scale"],
+                                         params_local["final_ln"].get("bias"),
+                                         model_cfg.layernorm_epsilon)
                     logits = lm_logits(model_cfg, params_local, h)
                     lab = jax.lax.dynamic_index_in_dim(labels, m, 0,
                                                        keepdims=False)

@@ -142,3 +142,40 @@ def test_preset_param_counts():
     cfg = presets.mistral("7B")
     n = num_params(cfg)
     assert 7.0e9 < n < 7.5e9
+
+
+def test_post_ln_convention():
+    """--use_post_ln: no pre-norm, per-layer output norm, no final stack
+    norm (ref transformer.py:660-664, :1278-1281)."""
+    import dataclasses
+
+    cfg = presets.tiny(vocab_size=64, seq_length=16, num_layers=2,
+                       hidden_size=32, num_attention_heads=4, num_kv_heads=2,
+                       ffn_hidden_size=64, normalization="layernorm")
+    post = dataclasses.replace(cfg, use_post_ln=True)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(0, 64, (2, 16)), jnp.int32)
+
+    out_pre = lm_forward(cfg, params, toks)
+    out_post = lm_forward(post, params, toks)
+    assert out_pre.shape == out_post.shape
+    # genuinely different layouts
+    assert float(jnp.abs(out_pre - out_post).max()) > 1e-3
+    # post-LN output is normalized by the last layer's own LN: a change to
+    # final_ln params must NOT affect it (final norm skipped)
+    p2 = jax.tree.map(lambda x: x, params)
+    p2["final_ln"] = {k: v * 3.0 for k, v in params["final_ln"].items()}
+    np.testing.assert_allclose(np.asarray(lm_forward(post, p2, toks)),
+                               np.asarray(out_post), rtol=1e-6)
+    # residual-post-layernorm variant runs and differs from both
+    rpl = dataclasses.replace(cfg, apply_residual_post_ln=True)
+    out_rpl = lm_forward(rpl, params, toks)
+    assert float(jnp.abs(out_rpl - out_pre).max()) > 1e-3
+    # both train
+    batch = {"tokens": toks, "labels": toks,
+             "loss_mask": jnp.ones((2, 16), jnp.float32)}
+    for c in (post, rpl):
+        g = jax.grad(lambda p: lm_loss(c, p, batch)[0])(params)
+        assert all(np.isfinite(np.asarray(x)).all()
+                   for x in jax.tree.leaves(jax.device_get(g)))
