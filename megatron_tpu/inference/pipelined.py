@@ -25,9 +25,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from megatron_tpu.config import ModelConfig
-from megatron_tpu.models.language_model import lm_logits
+from megatron_tpu.models.language_model import final_hidden_norm, lm_logits
 from megatron_tpu.models.transformer import block_forward
-from megatron_tpu.ops.normalization import norm_forward
 from megatron_tpu.ops.rotary import precompute_rope
 from megatron_tpu.training.pipeline import _embed_onehot
 
@@ -79,13 +78,7 @@ def make_pipelined_lm_forward(cfg: ModelConfig, mesh: Mesh, num_stages: int):
                 active, compute, lambda a: a, (state, ck, cv))
 
             def mk_logits(_):
-                if cfg.use_post_ln:  # post-LN layers end with their own norm
-                    h = state2
-                else:
-                    h = norm_forward(cfg.normalization, state2,
-                                     params_local["final_ln"]["scale"],
-                                     params_local["final_ln"].get("bias"),
-                                     cfg.layernorm_epsilon)
+                h = final_hidden_norm(cfg, params_local, state2)
                 return lm_logits(cfg, params_local, h).astype(jnp.float32)
 
             logits = jax.lax.cond(active & (stage == Pn - 1), mk_logits,

@@ -366,6 +366,11 @@ def params_to_hf_state_dict(
     params: Dict[str, Any], cfg: ModelConfig, model_type: str,
 ) -> Dict[str, np.ndarray]:
     """Inverse conversion (ref: weights_conversion/megatron_to_hf.py)."""
+    if cfg.use_post_ln:
+        raise ValueError(
+            "post-LN models have no HF export target: the supported HF "
+            "families (llama/mistral/falcon/gpt2) are pre-LN and expect a "
+            "final norm the post-LN layout does not have")
     f = {k: np.asarray(v) for k, v in _flatten(params)}
     L = cfg.num_layers
     sd: Dict[str, np.ndarray] = {}

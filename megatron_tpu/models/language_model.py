@@ -73,6 +73,17 @@ def embed_tokens(
     return x
 
 
+def final_hidden_norm(cfg: ModelConfig, params: Dict[str, Any],
+                      x: jnp.ndarray) -> jnp.ndarray:
+    """Final stack norm — identity under post-LN, where each layer ends
+    with its own output norm (ref transformer.py:1278-1281)."""
+    if cfg.use_post_ln:
+        return x
+    return norm_forward(cfg.normalization, x, params["final_ln"]["scale"],
+                        params["final_ln"].get("bias"),
+                        cfg.layernorm_epsilon)
+
+
 def lm_logits(cfg: ModelConfig, params: Dict[str, Any], x: jnp.ndarray) -> jnp.ndarray:
     """Project hidden states to vocab logits, tied or untied
     (ref: parallel_lm_logits, language_model.py:24-53)."""
@@ -148,10 +159,7 @@ def lm_forward(
     xs = (params["layers"], rates, layer_idx, kv_caches)
     x, new_caches = jax.lax.scan(body, x, xs)
 
-    if not cfg.use_post_ln:  # post-LN layers carry their own output norm
-        x = norm_forward(cfg.normalization, x, params["final_ln"]["scale"],
-                         params["final_ln"].get("bias"),
-                         cfg.layernorm_epsilon)
+    x = final_hidden_norm(cfg, params, x)
     if return_hidden:
         return x
 

@@ -48,11 +48,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from megatron_tpu.config import ModelConfig
 from megatron_tpu.models.language_model import (
-    _dropout, _layer_dropout_rates, lm_logits, _remat_policy,
+    _dropout, _layer_dropout_rates, final_hidden_norm, lm_logits,
+    _remat_policy,
 )
 from megatron_tpu.models.transformer import block_forward
 from megatron_tpu.ops.cross_entropy import cross_entropy_loss
-from megatron_tpu.ops.normalization import norm_forward
 from megatron_tpu.ops.rotary import precompute_rope
 
 
@@ -285,13 +285,7 @@ def make_pipeline_loss_fn(
                                 sharder=sharder)
 
                 def with_loss(_):
-                    if model_cfg.use_post_ln:
-                        h = out  # post-LN layers end with their own norm
-                    else:
-                        h = norm_forward(model_cfg.normalization, out,
-                                         params_local["final_ln"]["scale"],
-                                         params_local["final_ln"].get("bias"),
-                                         model_cfg.layernorm_epsilon)
+                    h = final_hidden_norm(model_cfg, params_local, out)
                     logits = lm_logits(model_cfg, params_local, h)
                     lab = jax.lax.dynamic_index_in_dim(labels, m, 0,
                                                        keepdims=False)

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Checkpoint copy / dtype-cast utility.
+
+The reference's tools/checkpoint_util.py + loader/saver plugins (907 LoC)
+exist to reshard checkpoints between tensor/pipeline layouts. Here that
+job is free — checkpoints are one logical orbax tree with sharding
+metadata and load at ANY topology (tests/test_checkpoint.py) — so this
+tool keeps only the remaining real uses: copying a checkpoint to a new
+directory, picking a specific iteration, and casting parameter dtype
+(e.g. fp32 masters -> bf16 serving weights).
+
+  python tools/checkpoint_util.py --load ckpts/run --save ckpts/export \
+      [--load_iters N] [--target_params_dtype bfloat16] [--params_only]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from megatron_tpu.platform import ensure_platform
+
+ensure_platform()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--load", required=True)
+    p.add_argument("--save", required=True)
+    p.add_argument("--load_iters", type=int, default=None)
+    p.add_argument("--target_params_dtype", default=None,
+                   choices=["bfloat16", "float16", "float32"])
+    p.add_argument("--params_only", action="store_true",
+                   help="drop optimizer state (a serving/export copy)")
+    args = p.parse_args(argv)
+
+    import json
+
+    import jax
+    import jax.numpy as jnp
+
+    from megatron_tpu.config import RunConfig
+    from megatron_tpu.models.params import init_params
+    from megatron_tpu.training import checkpointing
+    from megatron_tpu.training.optimizer import init_train_state
+
+    it = (args.load_iters if args.load_iters is not None
+          else checkpointing.read_tracker(args.load))
+    if it is None:
+        raise SystemExit(f"no checkpoint tracker in {args.load}")
+    meta_path = os.path.join(
+        checkpointing.checkpoint_dir(args.load, it), "meta.json")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    saved_cfg = meta.get("config") or {}
+    if "model" not in saved_cfg:
+        raise SystemExit(f"{meta_path} has no saved model config")
+    cfg = RunConfig.from_dict(saved_cfg)
+
+    params = init_params(cfg.model, jax.random.PRNGKey(0))
+    state = init_train_state(cfg.optimizer, params)
+    state, it, consumed = checkpointing.load_checkpoint(
+        args.load, state, iteration=it,
+        no_load_optim=args.params_only)
+    if args.params_only:
+        import dataclasses
+
+        zeroed = jax.tree.map(jnp.zeros_like, state.mu)
+        state = dataclasses.replace(state, mu=zeroed,
+                                    nu=jax.tree.map(jnp.zeros_like, state.nu))
+    if args.target_params_dtype:
+        import dataclasses
+
+        dt = jnp.dtype(args.target_params_dtype)
+        cast = lambda t: jax.tree.map(lambda x: x.astype(dt), t)
+        state = dataclasses.replace(state, params=cast(state.params))
+        saved_cfg["model"]["params_dtype"] = args.target_params_dtype
+
+    path = checkpointing.save_checkpoint(args.save, state, it, consumed,
+                                         config=saved_cfg)
+    print(f"wrote checkpoint (iteration {it}"
+          + (", params-only" if args.params_only else "")
+          + (f", params {args.target_params_dtype}"
+             if args.target_params_dtype else "")
+          + f") to {path}")
+    return path
+
+
+if __name__ == "__main__":
+    main()
