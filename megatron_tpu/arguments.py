@@ -101,6 +101,9 @@ def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
                    default=True,
                    help="SIGTERM checkpoint-and-exit is always enabled here")
     g.add_argument("--eval_only", action="store_true")
+    g.add_argument("--skip_iters", nargs="*", type=int, default=[],
+                   help="skip the update on these iterations (ref fault "
+                        "injection, training.py:397-425)")
 
     g = p.add_argument_group("learning rate")
     g.add_argument("--lr", type=float, default=3e-4)
@@ -181,6 +184,10 @@ def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
                         "lazy/cached impls are legacy)")
     g.add_argument("--mmap_warmup", action="store_true",
                    help="accepted for parity; the OS page cache handles it")
+    g.add_argument("--dataloader_type", default="single",
+                   choices=["single", "cyclic"],
+                   help="single = sequential deterministic resume; cyclic = "
+                        "epoch-seeded random order (ref data_samplers.py)")
     g.add_argument("--num_workers", type=int, default=2,
                    help="accepted for parity; the loader is synchronous "
                         "(host input is not the bottleneck on TPU)")
@@ -242,6 +249,12 @@ def args_to_run_config(args) -> RunConfig:
     if getattr(args, "log_timers_to_tensorboard", False):
         args.timing_log_level = max(args.timing_log_level, 1)
     gbs = args.global_batch_size or args.micro_batch_size
+    if getattr(args, "dataloader_type", "single") == "cyclic" \
+            and args.rampup_batch_size:
+        raise ValueError(
+            "--dataloader_type cyclic resumes by consumed-samples modulo a "
+            "FIXED batch size and breaks under --rampup_batch_size; use the "
+            "default sequential loader with rampup")
     if getattr(args, "lr_decay_samples", None) or getattr(
             args, "lr_warmup_samples", None):
         if args.rampup_batch_size:
@@ -389,6 +402,7 @@ def args_to_run_config(args) -> RunConfig:
         wandb_name=getattr(args, "wandb_name", None),
         timing_log_level=args.timing_log_level,
         eval_only=getattr(args, "eval_only", False),
+        skip_iters=tuple(getattr(args, "skip_iters", []) or []),
         scalar_loss_mask=args.scalar_loss_mask,
         variable_seq_lengths=args.variable_seq_lengths,
         metrics=tuple(args.metrics),

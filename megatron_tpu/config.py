@@ -357,6 +357,10 @@ class TrainingConfig:
     # run only the validation loop, then exit (ref --eval_only)
     eval_only: bool = False
 
+    # iterations whose update is skipped — crude fault injection
+    # (ref --skip_iters, training.py:397-425)
+    skip_iters: tuple = ()
+
     # loss averaging for instruction tuning (ref finetune.py scalar_loss_mask)
     scalar_loss_mask: float = 0.0
     variable_seq_lengths: bool = False

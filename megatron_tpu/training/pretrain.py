@@ -395,18 +395,28 @@ class TrainLoop:
                         self.log("data exhausted, stopping")
                         break
 
-                self.timers("step", 0).start()
-                metrics = self.train_step(batch)
-                loss_host = float(metrics["loss"])  # host sync
-                self.timers("step", 0).stop()
+                skipped_iter = (self.iteration + 1) in t.skip_iters
+                if skipped_iter:
+                    # fault injection: consume the data, skip the update
+                    # (ref --skip_iters, training.py:397-425); eval /
+                    # SIGTERM / exit / save checks below still run
+                    self.iteration += 1
+                    self.consumed_samples += gbs
+                    self.log(f"iteration {self.iteration}: update skipped "
+                             "(--skip_iters)")
+                else:
+                    self.timers("step", 0).start()
+                    metrics = self.train_step(batch)
+                    loss_host = float(metrics["loss"])  # host sync
+                    self.timers("step", 0).stop()
 
-                ntok = batch.get("tokens",
-                                 next(iter(batch.values()))).size
-                window_tokens += ntok
-                loss_avg += loss_host
-                loss_n += 1
+                    ntok = batch.get("tokens",
+                                     next(iter(batch.values()))).size
+                    window_tokens += ntok
+                    loss_avg += loss_host
+                    loss_n += 1
 
-                if self.iteration % t.log_interval == 0:
+                if not skipped_iter and self.iteration % t.log_interval == 0:
                     dt = time.time() - window_t0
                     tps = window_tokens / max(dt, 1e-9)
                     mfu_flops = tps * model_flops_per_token

@@ -27,7 +27,9 @@ initialize_distributed()
 
 from megatron_tpu.arguments import args_to_run_config, parse_args
 from megatron_tpu.data.gpt_dataset import build_gpt_datasets
-from megatron_tpu.data.samplers import PretrainingSampler, build_data_loader
+from megatron_tpu.data.samplers import (
+    PretrainingRandomSampler, PretrainingSampler, build_data_loader,
+)
 from megatron_tpu.training.pretrain import gpt_collate, pretrain
 
 
@@ -57,9 +59,17 @@ def main(argv=None):
         reset_position_ids=args.reset_position_ids)
 
     def train_iter_factory(consumed, gbs):
-        sampler = PretrainingSampler(
-            total_samples=len(train_ds), consumed_samples=consumed,
-            micro_batch_size=gbs, data_parallel_rank=0, data_parallel_size=1)
+        if args.dataloader_type == "cyclic":
+            # epoch-seeded random order (ref MegatronPretrainingRandomSampler)
+            sampler = PretrainingRandomSampler(
+                total_samples=len(train_ds), consumed_samples=consumed,
+                micro_batch_size=gbs, data_parallel_rank=0,
+                data_parallel_size=1, seed=t.seed)
+        else:
+            sampler = PretrainingSampler(
+                total_samples=len(train_ds), consumed_samples=consumed,
+                micro_batch_size=gbs, data_parallel_rank=0,
+                data_parallel_size=1)
         return build_data_loader(train_ds, sampler, collate_fn=collate)
 
     def valid_iter_factory():

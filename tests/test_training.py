@@ -185,3 +185,39 @@ def test_microbatch_calculator_rampup():
 def test_microbatch_calculator_validation():
     with pytest.raises(ValueError):
         MicroBatchCalculator(micro_batch_size=3, target_global_batch=16, data_parallel=1)
+
+
+def test_skip_iters_fault_injection(tmp_path):
+    """--skip_iters consumes data but skips the update; training continues
+    (ref training.py:397-425)."""
+    from megatron_tpu.config import (
+        ModelConfig, OptimizerConfig, ParallelConfig, RunConfig,
+        TrainingConfig,
+    )
+    from megatron_tpu.training.pretrain import TrainLoop
+
+    model = ModelConfig(num_layers=2, hidden_size=32, num_attention_heads=4,
+                        num_kv_heads=2, ffn_hidden_size=64, vocab_size=64,
+                        seq_length=16, params_dtype="float32").validate()
+    cfg = RunConfig(
+        model=model, parallel=ParallelConfig(),
+        optimizer=OptimizerConfig(lr=1e-3, lr_decay_style="constant"),
+        training=TrainingConfig(micro_batch_size=1, global_batch_size=8,
+                                train_iters=4, log_interval=1,
+                                skip_iters=(2,)))
+    logs = []
+    loop = TrainLoop(cfg, log=logs.append)
+    rng = np.random.default_rng(0)
+
+    def factory(consumed, gbs):
+        while True:
+            yield {"tokens": rng.integers(0, 64, (gbs, 16)).astype(np.int64),
+                   "labels": rng.integers(0, 64, (gbs, 16)).astype(np.int64),
+                   "loss_mask": np.ones((gbs, 16), np.float32)}
+
+    loop.train(factory)
+    assert loop.iteration == 4
+    assert loop.consumed_samples == 32  # skipped iteration still consumed
+    assert any("update skipped" in l for l in logs)
+    # optimizer stepped only 3 times
+    assert int(loop.state.step) == 3
