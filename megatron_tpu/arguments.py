@@ -223,6 +223,8 @@ def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
     g.add_argument("--wandb_name", default=None)
     g.add_argument("--timing_log_level", type=int, default=0)
     g.add_argument("--log_num_zeros_in_grad", action="store_true")
+    g.add_argument("--log_params_norm", action="store_true")
+    g.add_argument("--log_memory_to_tensorboard", action="store_true")
     g.add_argument("--log_validation_ppl_to_tensorboard", action="store_true",
                    default=True,
                    help="validation ppl always goes to the writer here")
@@ -403,6 +405,8 @@ def args_to_run_config(args) -> RunConfig:
         timing_log_level=args.timing_log_level,
         eval_only=getattr(args, "eval_only", False),
         skip_iters=tuple(getattr(args, "skip_iters", []) or []),
+        log_params_norm=getattr(args, "log_params_norm", False),
+        log_memory=getattr(args, "log_memory_to_tensorboard", False),
         scalar_loss_mask=args.scalar_loss_mask,
         variable_seq_lengths=args.variable_seq_lengths,
         metrics=tuple(args.metrics),

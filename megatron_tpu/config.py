@@ -361,6 +361,11 @@ class TrainingConfig:
     # (ref --skip_iters, training.py:397-425)
     skip_iters: tuple = ()
 
+    # extra per-log-interval scalars (ref --log_params_norm,
+    # --log_memory_to_tensorboard)
+    log_params_norm: bool = False
+    log_memory: bool = False
+
     # loss averaging for instruction tuning (ref finetune.py scalar_loss_mask)
     scalar_loss_mask: float = 0.0
     variable_seq_lengths: bool = False

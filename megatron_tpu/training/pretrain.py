@@ -282,6 +282,24 @@ class TrainLoop:
                 donate_argnums=(0,))
         return self._step_cache[num_microbatches]
 
+    def _params_norm(self) -> float:
+        """Global params L2 (ref calc_params_l2_norm, utils.py:33-80)."""
+        if not hasattr(self, "_params_norm_fn"):
+            self._params_norm_fn = jax.jit(lambda p: jnp.sqrt(sum(
+                jnp.sum(jnp.square(x.astype(jnp.float32)))
+                for x in jax.tree.leaves(p))))
+        return float(self._params_norm_fn(self.state.params))
+
+    def _memory_stats(self) -> Dict[str, float]:
+        """Device memory scalars (ref report_memory, utils.py:82-97);
+        empty on backends without memory_stats (CPU)."""
+        stats = jax.local_devices()[0].memory_stats() or {}
+        out = {}
+        for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            if k in stats:
+                out[k.replace("bytes", "mb")] = stats[k] / 1e6
+        return out
+
     def _put_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, jnp.ndarray]:
         multihost = jax.process_count() > 1
         if multihost:
@@ -416,7 +434,13 @@ class TrainLoop:
                     loss_avg += loss_host
                     loss_n += 1
 
-                if not skipped_iter and self.iteration % t.log_interval == 0:
+                if self.iteration % t.log_interval == 0 and loss_n == 0:
+                    # window had only skipped iterations: still close it
+                    self.log(f"iteration {self.iteration}/{t.train_iters} | "
+                             f"consumed samples: {self.consumed_samples} | "
+                             "all iterations in window skipped")
+                    window_tokens, window_t0 = 0, time.time()
+                if self.iteration % t.log_interval == 0 and loss_n > 0:
                     dt = time.time() - window_t0
                     tps = window_tokens / max(dt, 1e-9)
                     mfu_flops = tps * model_flops_per_token
@@ -439,6 +463,18 @@ class TrainLoop:
                                            self.iteration)
                     self.writer.add_scalar("train/tokens_per_sec", tps,
                                            self.iteration)
+                    if "num_zeros" in metrics:
+                        self.writer.add_scalar(
+                            "train/num_zeros", float(metrics["num_zeros"]),
+                            self.iteration)
+                    if t.log_params_norm:
+                        self.writer.add_scalar("train/params_norm",
+                                               self._params_norm(),
+                                               self.iteration)
+                    if t.log_memory:
+                        for k, v in self._memory_stats().items():
+                            self.writer.add_scalar(f"memory/{k}", v,
+                                                   self.iteration)
                     self.writer.flush()
                     window_tokens, window_t0 = 0, time.time()
                     loss_avg, loss_n = 0.0, 0

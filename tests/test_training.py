@@ -221,3 +221,41 @@ def test_skip_iters_fault_injection(tmp_path):
     assert any("update skipped" in l for l in logs)
     # optimizer stepped only 3 times
     assert int(loop.state.step) == 3
+
+
+def test_log_params_norm_and_memory(tmp_path):
+    """--log_params_norm / --log_memory_to_tensorboard scalars reach the
+    writer (memory stats may be empty on CPU)."""
+    from megatron_tpu.config import (
+        ModelConfig, OptimizerConfig, ParallelConfig, RunConfig,
+        TrainingConfig,
+    )
+    from megatron_tpu.training.pretrain import TrainLoop
+
+    model = ModelConfig(num_layers=2, hidden_size=32, num_attention_heads=4,
+                        num_kv_heads=2, ffn_hidden_size=64, vocab_size=64,
+                        seq_length=16, params_dtype="float32").validate()
+    cfg = RunConfig(
+        model=model, parallel=ParallelConfig(),
+        optimizer=OptimizerConfig(lr=1e-3, lr_decay_style="constant"),
+        training=TrainingConfig(micro_batch_size=1, global_batch_size=8,
+                                train_iters=2, log_interval=1,
+                                log_params_norm=True, log_memory=True))
+    loop = TrainLoop(cfg, log=lambda s: None)
+    scalars = {}
+    loop.writer.add_scalar = lambda k, v, step: scalars.setdefault(k, v)
+    rng = np.random.default_rng(0)
+
+    def factory(consumed, gbs):
+        while True:
+            yield {"tokens": rng.integers(0, 64, (gbs, 16)).astype(np.int64),
+                   "labels": rng.integers(0, 64, (gbs, 16)).astype(np.int64),
+                   "loss_mask": np.ones((gbs, 16), np.float32)}
+
+    loop.train(factory)
+    assert scalars["train/params_norm"] > 0
+    norm = loop._params_norm()
+    leaves = jax.tree.leaves(jax.device_get(loop.state.params))
+    want = float(np.sqrt(sum((np.asarray(x, np.float64) ** 2).sum()
+                             for x in leaves)))
+    np.testing.assert_allclose(norm, want, rtol=1e-4)
