@@ -90,10 +90,20 @@ def build_data_loader(
     dataset,
     sampler,
     collate_fn=None,
+    prefetch: int = 2,
 ) -> Iterator[Dict[str, np.ndarray]]:
-    """Yield collated numpy batches forever (re-iterating the sampler after
-    exhaustion, with consumed_samples advanced by the caller via sampler
-    state)."""
+    """Yield collated numpy batches for ONE pass over the sampler; the
+    train loop rebuilds the loader at epoch/rampup boundaries (sampler
+    order is a pure function of consumed_samples, advanced by the caller).
+
+    prefetch > 0 runs dataset access + collation on a background thread
+    with a bounded queue, overlapping host input work with device steps —
+    the TPU-appropriate stand-in for the reference's torch DataLoader
+    worker pool (--num_workers; order and determinism are unchanged,
+    batches are produced strictly in sampler order). prefetch=0 is the
+    plain synchronous path. Closing/abandoning the iterator stops the
+    worker thread (generator finalization sets the stop flag).
+    """
     def default_collate(items):
         out: Dict[str, np.ndarray] = {}
         for k in items[0]:
@@ -101,5 +111,46 @@ def build_data_loader(
         return out
 
     collate = collate_fn or default_collate
-    for idx_batch in sampler:
-        yield collate([dataset[i] for i in idx_batch])
+
+    if prefetch <= 0:
+        for idx_batch in sampler:
+            yield collate([dataset[i] for i in idx_batch])
+        return
+
+    import queue
+    import threading
+
+    q: "queue.Queue" = queue.Queue(maxsize=prefetch)
+    stop = threading.Event()
+    _END = object()
+
+    def _put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def worker():
+        try:
+            for idx_batch in sampler:
+                if not _put(collate([dataset[i] for i in idx_batch])):
+                    return
+            _put(_END)
+        except BaseException as e:  # surfaced to the consumer
+            _put(e)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
