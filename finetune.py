@@ -63,7 +63,8 @@ def main(argv=None):
             total_samples=len(train_ds), consumed_samples=consumed,
             micro_batch_size=gbs, data_parallel_rank=0,
             data_parallel_size=1, seed=t.seed)
-        return build_data_loader(train_ds, sampler, collate_fn=collate)
+        return build_data_loader(train_ds, sampler, collate_fn=collate,
+                                 prefetch=args.num_workers)
 
     pretrain(cfg, train_iter_factory)
 

@@ -189,8 +189,8 @@ def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
                    help="single = sequential deterministic resume; cyclic = "
                         "epoch-seeded random order (ref data_samplers.py)")
     g.add_argument("--num_workers", type=int, default=2,
-                   help="accepted for parity; the loader is synchronous "
-                        "(host input is not the bottleneck on TPU)")
+                   help="prefetch depth of the threaded batch loader "
+                        "(0 = synchronous)")
     g.add_argument("--tokenizer_type", default="SentencePieceTokenizer")
     g.add_argument("--vocab_file", default=None)
     g.add_argument("--merges_file", default=None)
@@ -225,6 +225,8 @@ def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
     g.add_argument("--log_num_zeros_in_grad", action="store_true")
     g.add_argument("--log_params_norm", action="store_true")
     g.add_argument("--log_memory_to_tensorboard", action="store_true")
+    g.add_argument("--log_batch_size_to_tensorboard", action="store_true")
+    g.add_argument("--log_world_size_to_tensorboard", action="store_true")
     g.add_argument("--log_validation_ppl_to_tensorboard", action="store_true",
                    default=True,
                    help="validation ppl always goes to the writer here")
@@ -407,6 +409,8 @@ def args_to_run_config(args) -> RunConfig:
         skip_iters=tuple(getattr(args, "skip_iters", []) or []),
         log_params_norm=getattr(args, "log_params_norm", False),
         log_memory=getattr(args, "log_memory_to_tensorboard", False),
+        log_batch_size=getattr(args, "log_batch_size_to_tensorboard", False),
+        log_world_size=getattr(args, "log_world_size_to_tensorboard", False),
         scalar_loss_mask=args.scalar_loss_mask,
         variable_seq_lengths=args.variable_seq_lengths,
         metrics=tuple(args.metrics),

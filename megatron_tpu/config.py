@@ -365,6 +365,8 @@ class TrainingConfig:
     # --log_memory_to_tensorboard)
     log_params_norm: bool = False
     log_memory: bool = False
+    log_batch_size: bool = False
+    log_world_size: bool = False
 
     # loss averaging for instruction tuning (ref finetune.py scalar_loss_mask)
     scalar_loss_mask: float = 0.0

@@ -467,6 +467,13 @@ class TrainLoop:
                         self.writer.add_scalar(
                             "train/num_zeros", float(metrics["num_zeros"]),
                             self.iteration)
+                    if t.log_batch_size:
+                        self.writer.add_scalar("train/global_batch_size",
+                                               gbs, self.iteration)
+                    if t.log_world_size:
+                        self.writer.add_scalar("train/world_size",
+                                               jax.device_count(),
+                                               self.iteration)
                     if t.log_params_norm:
                         self.writer.add_scalar("train/params_norm",
                                                self._params_norm(),

@@ -80,7 +80,8 @@ def main(argv=None):
 
     def train_iter_factory(consumed, gbs):
         sampler = PretrainingSampler(len(train_ds), consumed, gbs, 0, 1)
-        return build_data_loader(train_ds, sampler)
+        return build_data_loader(train_ds, sampler,
+                                 prefetch=args.num_workers)
 
     def bert_loss_fn(model_cfg, p, b, key, sharder=None):
         kw = {"sharder": sharder} if sharder is not None else {}

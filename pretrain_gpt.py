@@ -70,7 +70,8 @@ def main(argv=None):
                 total_samples=len(train_ds), consumed_samples=consumed,
                 micro_batch_size=gbs, data_parallel_rank=0,
                 data_parallel_size=1)
-        return build_data_loader(train_ds, sampler, collate_fn=collate)
+        return build_data_loader(train_ds, sampler, collate_fn=collate,
+                                 prefetch=args.num_workers)
 
     def valid_iter_factory():
         if valid_ds is None:
@@ -79,7 +80,8 @@ def main(argv=None):
             total_samples=len(valid_ds), consumed_samples=0,
             micro_batch_size=t.global_batch_size, data_parallel_rank=0,
             data_parallel_size=1)
-        return build_data_loader(valid_ds, sampler, collate_fn=collate)
+        return build_data_loader(valid_ds, sampler, collate_fn=collate,
+                                 prefetch=args.num_workers)
 
     pretrain(cfg, train_iter_factory, valid_iter_factory)
 

@@ -94,7 +94,8 @@ def main(argv=None):
 
     def train_iter_factory(consumed, gbs):
         sampler = PretrainingSampler(len(train_ds), consumed, gbs, 0, 1)
-        return build_data_loader(train_ds, sampler, collate_fn=collate)
+        return build_data_loader(train_ds, sampler, collate_fn=collate,
+                                 prefetch=args.num_workers)
 
     def loss_fn(model_cfg, p, b, key):
         return biencoder_loss(model_cfg, p, b, dropout_key=key,
