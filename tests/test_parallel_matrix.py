@@ -26,10 +26,9 @@ COMBOS = {
 }
 
 
-@pytest.mark.parametrize("name", sorted(COMBOS))
-@pytest.mark.parametrize("zero1", [False, True])
-def test_train_loop_topology_matrix(name, zero1):
-    par = ParallelConfig(**COMBOS[name])
+def _two_steps(parallel_kwargs, zero1, recompute, tag):
+    """Build a TrainLoop for the combo, run two steps, assert descent."""
+    par = ParallelConfig(**parallel_kwargs)
     model = ModelConfig(num_layers=4, hidden_size=32, num_attention_heads=4,
                         num_kv_heads=2, ffn_hidden_size=64, vocab_size=128,
                         seq_length=32, params_dtype="float32").validate()
@@ -39,7 +38,7 @@ def test_train_loop_topology_matrix(name, zero1):
                                   use_distributed_optimizer=zero1),
         training=TrainingConfig(micro_batch_size=1, global_batch_size=8,
                                 train_iters=2, log_interval=1,
-                                recompute_granularity="full"))
+                                recompute_granularity=recompute))
     loop = TrainLoop(cfg, log=lambda s: None)
     rng = np.random.default_rng(0)
     batch = {"tokens": rng.integers(0, 128, (8, 32)).astype(np.int64),
@@ -47,5 +46,19 @@ def test_train_loop_topology_matrix(name, zero1):
              "loss_mask": np.ones((8, 32), np.float32)}
     m1 = loop.train_step(batch)
     m2 = loop.train_step(batch)
-    assert np.isfinite(float(m1["loss"]))
-    assert float(m2["loss"]) < float(m1["loss"]), (name, zero1)
+    assert np.isfinite(float(m1["loss"])), tag
+    assert float(m2["loss"]) < float(m1["loss"]), tag
+
+
+@pytest.mark.parametrize("name", sorted(COMBOS))
+@pytest.mark.parametrize("zero1", [False, True])
+def test_train_loop_topology_matrix(name, zero1):
+    _two_steps(COMBOS[name], zero1, "full", (name, zero1))
+
+
+@pytest.mark.parametrize("recompute", ["none", "selective"])
+def test_train_loop_recompute_granularities(recompute):
+    """The other two recompute policies on a mixed mesh (the matrix above
+    runs 'full')."""
+    _two_steps(dict(tensor_parallel=2, pipeline_parallel=2), True, recompute,
+               ("tp2_pp2", recompute))
