@@ -16,7 +16,6 @@ Pipeline-parallel schedules live in megatron_tpu/training/pipeline.py.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
