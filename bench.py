@@ -100,14 +100,11 @@ def main():
     flops_per_token = 3.0 * cfg.flops_per_token_fwd()  # fwd + bwd(2x)
     achieved = tokens_per_sec * flops_per_token
 
-    # peak bf16 FLOP/s by TPU generation
-    peaks = {"v4": 275e12, "v5 lite": 197e12, "v5e": 197e12, "v5litepod": 197e12,
-             "v5p": 459e12, "v5 p": 459e12, "v6e": 918e12, "v6 lite": 918e12}
+    from megatron_tpu.platform import peak_bf16_flops
+
     dev = jax.devices()[0]
     kind = getattr(dev, "device_kind", str(dev)).lower()
-    peak = next((v for k, v in peaks.items() if k in kind), None)
-    if peak is None:
-        peak = 197e12  # unknown generation: scored against v5e, flagged below
+    peak = peak_bf16_flops(dev)
     mfu = achieved / peak
 
     baseline_mfu = 900 * 6 * 6.74e9 / 312e12  # reference A100 finetune

@@ -43,3 +43,21 @@ def ensure_platform() -> None:
     import jax
 
     jax.config.update("jax_platforms", forced)
+
+
+# Peak dense bf16 FLOP/s by TPU generation (public spec-sheet numbers),
+# keyed by substrings of jax's device_kind. Single source of truth for the
+# MFU denominator in bench.py / tools/bench_sweep.py.
+_PEAK_BF16 = {
+    "v4": 275e12,
+    "v5 lite": 197e12, "v5e": 197e12, "v5litepod": 197e12,
+    "v5p": 459e12, "v5 p": 459e12,
+    "v6e": 918e12, "v6 lite": 918e12,
+}
+
+
+def peak_bf16_flops(device) -> float:
+    """Peak bf16 FLOP/s for a jax device; falls back to the v5e figure for
+    unknown generations (conservative: over-reports nothing newer)."""
+    kind = getattr(device, "device_kind", str(device)).lower()
+    return next((v for k, v in _PEAK_BF16.items() if k in kind), 197e12)

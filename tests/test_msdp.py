@@ -1,0 +1,103 @@
+"""MSDP dialogue-prompting harness (counterpart: reference tasks/msdp/ —
+prompt construction, generation driving, token-F1 evaluation)."""
+
+import json
+
+import pytest
+
+from tasks.msdp import (
+    build_knowledge_input, build_response_input, corpus_f1, evaluate_f1,
+    first_line_continuation, generate_file, normalize_answer,
+    read_knowledge_prompts, read_response_prompt, token_f1, word_tokenize,
+)
+
+
+def test_normalize_answer_strips_articles_punct_case():
+    assert normalize_answer("The  Quick, (brown) fox!") == "quick brown fox"
+
+
+def test_token_f1_exact_and_partial():
+    p, r, f = token_f1("the cat sat", "the cat sat")
+    assert f == pytest.approx(1.0)
+    p, r, f = token_f1("cat dog", "cat bird fish")
+    # 1 common token; precision 1/2, recall 1/3
+    assert p == pytest.approx(0.5)
+    assert r == pytest.approx(1 / 3)
+    assert f == pytest.approx(2 * 0.5 * (1 / 3) / (0.5 + 1 / 3))
+
+
+def test_token_f1_empty_gold_excluded_empty_guess_zero():
+    assert token_f1("anything", "") == (None, None, None)
+    assert token_f1("", "gold") == (0.0, 0.0, 0.0)
+    # corpus mean skips the empty-gold pair entirely
+    p, r, f = corpus_f1(["a b", "ignored"], ["a b", ""])
+    assert f == pytest.approx(1.0)
+
+
+def test_word_tokenize_splits_punctuation():
+    assert word_tokenize("Hello, world!") == ["Hello", ",", "world", "!"]
+
+
+def test_prompt_files_and_input_construction(tmp_path):
+    kfile = tmp_path / "k.jsonl"
+    kfile.write_text(
+        json.dumps({"jazz what do you like?": ["( ex1 ) jazz => fact one",
+                                               "( ex2 ) jazz => fact two"]})
+        + "\n")
+    prompts = read_knowledge_prompts(str(kfile))
+    line = "jazz\thi there [SEP] what do you like?"
+    inp = build_knowledge_input(line, prompts)
+    assert inp.endswith("( what do you like? ) jazz =>")
+    assert "fact one \n" in inp and "fact two \n" in inp
+
+    rfile = tmp_path / "r.txt"
+    rfile.write_text("example a\nexample b\nexample c\n")
+    prompt = read_response_prompt(str(rfile), 2)
+    assert prompt == "example a \nexample b \n"
+    line = "jazz\tfirst [SEP] tell me more.\tJazz is music."
+    inp = build_response_input(line, prompt)
+    assert inp.startswith("example a \nexample b \nTopic: jazz. ")
+    assert "User says: tell me more ." in inp
+    assert "We know that: Jazz is music ." in inp
+    assert inp.endswith("System replies:")
+
+
+def test_first_line_continuation():
+    assert first_line_continuation("PROMPT gen text\nsecond", 6) == "gen text"
+
+
+def test_generate_file_and_evaluate_f1(tmp_path):
+    kfile = tmp_path / "k.jsonl"
+    kfile.write_text(json.dumps({"t q1": ["( e ) t => f"]}) + "\n"
+                     + json.dumps({"t q2": ["( e ) t => g"]}) + "\n")
+    samples = tmp_path / "in.tsv"
+    samples.write_text("t\ta [SEP] q1\nt\tq2\n")
+    out = tmp_path / "out.txt"
+
+    def fake_gen(prompt):
+        return prompt + " the answer is blue \n trailing junk"
+
+    n = generate_file(str(samples), str(out), "knowledge", str(kfile),
+                      fake_gen)
+    assert n == 2
+    lines = out.read_text().splitlines()
+    assert lines == ["the answer is blue", "the answer is blue"]
+
+    gold = tmp_path / "gold.txt"
+    gold.write_text("the answer is blue\nno_passages_used\n")
+    p, r, f1 = evaluate_f1(str(out), str(gold))
+    assert f1 == pytest.approx(1.0)  # empty-gold second pair excluded
+
+
+def test_evaluate_f1_strips_endoftext_from_guesses(tmp_path):
+    guess = tmp_path / "guess.txt"
+    guess.write_text("blue sky<|endoftext|>\n")
+    gold = tmp_path / "gold.txt"
+    gold.write_text("blue sky\n")
+    _, _, f1 = evaluate_f1(str(guess), str(gold))
+    assert f1 == pytest.approx(1.0)
+
+
+def test_generate_file_bad_prompt_type(tmp_path):
+    with pytest.raises(ValueError):
+        generate_file("x", "y", "nope", "z", lambda s: s)
