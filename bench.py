@@ -13,6 +13,9 @@ convention the reference's FLOP formula supports
 Baseline (BASELINE.md): the reference's Llama-2-7B finetune does ~0.9k
 tokens/s per A100-80GB => MFU = 900 * 6 * 6.74e9 / 312e12 = 0.1166.
 vs_baseline is our MFU / that.
+
+tools/bench_sweep.py imports headline_config/build_step/time_step so sweep
+points are measured with exactly the headline methodology.
 """
 
 import json
@@ -20,99 +23,128 @@ import os
 import sys
 import time
 
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 
+BASELINE_MFU = 900 * 6 * 6.74e9 / 312e12  # reference A100 finetune
 
-def main():
+
+def headline_config(seq_length: int = 2048):
+    """The headline bench geometry: llama-family, ~640M params — fits one
+    chip's HBM with fp32 master + Adam moments."""
+    from megatron_tpu.models import presets
+
+    return presets.tiny(
+        vocab_size=32000, seq_length=seq_length, hidden_size=2048,
+        num_layers=10, num_attention_heads=16, num_kv_heads=16,
+        ffn_hidden_size=5504, params_dtype="bfloat16",
+        attention_impl="pallas",
+    )
+
+
+def build_step(cfg, micro_bs: int, granularity: str):
+    """(state, jitted_step, batch) for one config; fresh state every call."""
     import jax
     import jax.numpy as jnp
 
     from megatron_tpu.config import OptimizerConfig, TrainingConfig
-    from megatron_tpu.models import presets
-    from megatron_tpu.models.params import init_params, num_params
+    from megatron_tpu.models.params import init_params
     from megatron_tpu.training.optimizer import init_train_state
     from megatron_tpu.training.train_step import make_train_step
 
-    # llama-family geometry, ~640M params: fits HBM with fp32 master+moments
-    cfg = presets.tiny(
-        vocab_size=32000, seq_length=2048, hidden_size=2048, num_layers=10,
-        num_attention_heads=16, num_kv_heads=16, ffn_hidden_size=5504,
-        params_dtype="bfloat16", attention_impl="pallas",
-    )
-    n_params = num_params(cfg)
-
     opt_cfg = OptimizerConfig(lr=1e-4, lr_decay_style="constant")
-    micro_bs = 4
-
+    tcfg = TrainingConfig(micro_batch_size=micro_bs,
+                          global_batch_size=micro_bs,
+                          recompute_granularity=granularity, seed=0)
     rng = np.random.default_rng(0)
     batch = {
-        "tokens": jnp.asarray(rng.integers(0, cfg.vocab_size, (micro_bs, cfg.seq_length)), jnp.int32),
-        "labels": jnp.asarray(rng.integers(0, cfg.vocab_size, (micro_bs, cfg.seq_length)), jnp.int32),
+        "tokens": jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (micro_bs, cfg.seq_length)),
+            jnp.int32),
+        "labels": jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (micro_bs, cfg.seq_length)),
+            jnp.int32),
         "loss_mask": jnp.ones((micro_bs, cfg.seq_length), jnp.float32),
     }
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    state = init_train_state(opt_cfg, params)
+    step = jax.jit(
+        make_train_step(cfg, opt_cfg, tcfg, num_microbatches=1,
+                        train_iters=1000),
+        donate_argnums=(0,),
+    )
+    return state, step, batch
 
-    # try no recompute first (fastest when activations fit HBM), fall back
-    # to selective on OOM. Warmup syncs via host transfer (float()) — on
-    # the axon TPU plugin block_until_ready returns without waiting.
-    recompute = None
-    for granularity in ("none", "selective"):
-        tcfg = TrainingConfig(micro_batch_size=micro_bs,
-                              global_batch_size=micro_bs,
-                              recompute_granularity=granularity, seed=0)
-        params = init_params(cfg, jax.random.PRNGKey(0))
-        state = init_train_state(opt_cfg, params)
-        step = jax.jit(
-            make_train_step(cfg, opt_cfg, tcfg, num_microbatches=1,
-                            train_iters=1000),
-            donate_argnums=(0,),
-        )
-        try:
-            state, metrics = step(state, batch)
-            float(metrics["loss"])
-            state, metrics = step(state, batch)
-            float(metrics["loss"])
-            recompute = granularity
-            break
-        except Exception as e:  # XlaRuntimeError OOM etc.
-            if "RESOURCE_EXHAUSTED" not in str(e) and "memory" not in str(e).lower():
-                raise
-            # free the failed attempt before the fallback allocates
-            del params, state, step
-            print(f"# recompute={granularity} OOM, retrying", file=sys.stderr)
-    if recompute is None:
-        raise RuntimeError("both recompute granularities OOMed")
 
-    iters = 5
-    profile_dir = os.environ.get("MEGATRON_TPU_PROFILE_DIR")
-    if profile_dir:
-        jax.profiler.start_trace(profile_dir)
+def time_step(state, step, batch, iters: int = 5):
+    """(seconds_per_step, loss, state) after a 2-step warmup. Syncs via a
+    host transfer (float()) — on the axon TPU plugin block_until_ready
+    returns without waiting."""
+    state, metrics = step(state, batch)
+    float(metrics["loss"])
+    state, metrics = step(state, batch)
+    float(metrics["loss"])
     t0 = time.perf_counter()
     for _ in range(iters):
         state, metrics = step(state, batch)
-    loss_val = float(metrics["loss"])
+    loss = float(metrics["loss"])
     dt = (time.perf_counter() - t0) / iters
-    if profile_dir:
-        jax.profiler.stop_trace()
+    return dt, loss, state
+
+
+def is_oom(e: Exception) -> bool:
+    return "RESOURCE_EXHAUSTED" in str(e) or "memory" in str(e).lower()
+
+
+def main():
+    import jax
+
+    from megatron_tpu.models.params import num_params
+    from megatron_tpu.platform import peak_bf16_flops
+
+    cfg = headline_config()
+    n_params = num_params(cfg)
+    micro_bs = 4
+
+    # try no recompute first (fastest when activations fit HBM), fall back
+    # to selective on OOM
+    result = None
+    for granularity in ("none", "selective"):
+        state, step, batch = build_step(cfg, micro_bs, granularity)
+        profile_dir = os.environ.get("MEGATRON_TPU_PROFILE_DIR")
+        try:
+            if profile_dir:
+                _ = time_step(state, step, batch, iters=1)  # compile first
+                jax.profiler.start_trace(profile_dir)
+            dt, loss_val, state = time_step(state, step, batch)
+            if profile_dir:
+                jax.profiler.stop_trace()
+            result = (granularity, dt, loss_val)
+            break
+        except Exception as e:  # XlaRuntimeError OOM etc.
+            if not is_oom(e):
+                raise
+            del state, step  # free the failed attempt before the fallback
+            print(f"# recompute={granularity} OOM, retrying", file=sys.stderr)
+    if result is None:
+        raise RuntimeError("both recompute granularities OOMed")
+    recompute, dt, loss_val = result
 
     tokens_per_sec = micro_bs * cfg.seq_length / dt
     flops_per_token = 3.0 * cfg.flops_per_token_fwd()  # fwd + bwd(2x)
     achieved = tokens_per_sec * flops_per_token
-
-    from megatron_tpu.platform import peak_bf16_flops
 
     dev = jax.devices()[0]
     kind = getattr(dev, "device_kind", str(dev)).lower()
     peak = peak_bf16_flops(dev)
     mfu = achieved / peak
 
-    baseline_mfu = 900 * 6 * 6.74e9 / 312e12  # reference A100 finetune
     print(json.dumps({
         "metric": "llama_train_step_mfu",
         "value": round(mfu, 4),
         "unit": "fraction_of_peak_bf16",
-        "vs_baseline": round(mfu / baseline_mfu, 3),
+        "vs_baseline": round(mfu / BASELINE_MFU, 3),
         "detail": {
             "tokens_per_sec_per_chip": round(tokens_per_sec),
             "step_ms": round(dt * 1e3, 2),

@@ -96,6 +96,7 @@ class TrainLoop:
         param_specs_fn: Optional[Callable] = None,
         loss_fn: Optional[Callable] = None,
         fixed_num_microbatches: Optional[int] = None,
+        pipeline_loss_factory: Optional[Callable] = None,
     ):
         """init_params_fn(model_cfg, key) / param_specs_fn(model_cfg) let
         task entry points with their own parameter trees (T5's separate
@@ -103,7 +104,13 @@ class TrainLoop:
         language model. loss_fn(model_cfg, params, batch, key) swaps the
         training objective (BERT/T5/ICT entries); fixed_num_microbatches
         pins the microbatch count regardless of batch size (ICT's in-batch
-        softmax needs the whole global batch as negatives)."""
+        softmax needs the whole global batch as negatives).
+
+        pipeline_loss_factory(model_cfg, mesh, num_stages,
+        num_microbatches, recompute) -> loss_fn(params, batch, key) lets a
+        task model supply its own pipelined schedule at pp>1 (T5's
+        enc+dec interleaved ring, training/t5_pipeline.py); the built-in
+        GPT schedule is used when it is None."""
         run_cfg.validate()
         self.cfg = run_cfg
         self.log = log
@@ -167,12 +174,15 @@ class TrainLoop:
         self._step_cache: Dict[int, Callable] = {}
         self.loss_fn = loss_fn
         self.fixed_num_microbatches = fixed_num_microbatches
-        if loss_fn is not None and self.rt.pp > 1:
+        self.pipeline_loss_factory = pipeline_loss_factory
+        if (loss_fn is not None and self.rt.pp > 1
+                and pipeline_loss_factory is None):
             raise ValueError(
                 "pipeline parallelism drives the built-in LM loss through "
-                "the pipe schedule; task losses (BERT/T5/ICT/classification)"
-                " would silently train unpipelined — use tensor/data/context"
-                " parallelism for them instead")
+                "the pipe schedule; task losses (BERT/ICT/classification) "
+                "would silently train unpipelined — use tensor/data/context"
+                " parallelism for them, or supply a pipeline_loss_factory "
+                "(T5 has one: training/t5_pipeline.py)")
         self.eval_step = None
         # task entry points (BERT/T5/ICT) set this to their loss for
         # evaluate(); defaults to loss_fn without the dropout key
@@ -249,7 +259,11 @@ class TrainLoop:
         if num_microbatches not in self._step_cache:
             pp = self.rt.pp
             pp_loss_fn = None
-            if pp > 1 and self.loss_fn is None:
+            if pp > 1 and self.pipeline_loss_factory is not None:
+                pp_loss_fn = self.pipeline_loss_factory(
+                    self.cfg.model, self.rt.mesh, pp, num_microbatches,
+                    self.cfg.training.recompute_granularity)
+            elif pp > 1 and self.loss_fn is None:
                 recompute = self.cfg.training.recompute_granularity
                 pp_loss_fn = make_pipeline_loss_fn(
                     self.cfg.model, self.rt.mesh, pp, num_microbatches,

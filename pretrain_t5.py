@@ -94,8 +94,20 @@ def main(argv=None):
     def t5_loss_fn(model_cfg, p, b, key):
         return t5_loss(model_cfg, p, b)
 
+    pp_factory = None
+    if cfg.parallel.pipeline_parallel > 1:
+        from megatron_tpu.training.t5_pipeline import make_t5_pipeline_loss_fn
+
+        if (cfg.parallel.virtual_pipeline_parallel or 1) > 1:
+            raise SystemExit(
+                "T5 pp>1 is already interleaved (encoder+decoder chunks "
+                "per stage); --num_layers_per_virtual_pipeline_stage "
+                "doesn't apply")
+        pp_factory = make_t5_pipeline_loss_fn
+
     loop = TrainLoop(cfg, init_params_fn=t5_init_params,
-                     param_specs_fn=t5_param_specs, loss_fn=t5_loss_fn)
+                     param_specs_fn=t5_param_specs, loss_fn=t5_loss_fn,
+                     pipeline_loss_factory=pp_factory)
     loop.train(train_iter_factory)
 
 

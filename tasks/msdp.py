@@ -223,7 +223,7 @@ def _api_generate_fn(url: str, out_seq_length: int):
                              "tokens_to_generate": out_seq_length,
                              "top_k": 1}).encode(),
             headers={"Content-Type": "application/json; charset=UTF-8"})
-        with urllib.request.urlopen(req) as resp:
+        with urllib.request.urlopen(req, timeout=600) as resp:
             return json.loads(resp.read())["text"][0]
 
     return gen

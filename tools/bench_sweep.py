@@ -1,9 +1,10 @@
 #!/usr/bin/env python
 """Single-chip MFU sweep over micro-batch size / recompute granularity.
 
-Same methodology as bench.py (jitted full train step, 3x-forward FLOP
-accounting); prints one JSON line per configuration that fits.  Used for
-profile-guided tuning of the headline bench configuration.
+Reuses bench.py's headline_config/build_step/time_step so every sweep
+point is measured with exactly the headline methodology (same geometry,
+warmup, sync and FLOP accounting); prints one JSON line per configuration
+that fits.
 
   python tools/bench_sweep.py --micro_bs 4 8 --recompute selective none
 """
@@ -12,66 +13,26 @@ import argparse
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import numpy as np
+from bench import build_step, headline_config, is_oom, time_step
 
 
 def run_one(micro_bs, granularity, seq_length=2048, iters=5):
     import jax
-    import jax.numpy as jnp
-
-    from megatron_tpu.config import OptimizerConfig, TrainingConfig
-    from megatron_tpu.models import presets
-    from megatron_tpu.models.params import init_params
-    from megatron_tpu.training.optimizer import init_train_state
-    from megatron_tpu.training.train_step import make_train_step
-
-    cfg = presets.tiny(
-        vocab_size=32000, seq_length=seq_length, hidden_size=2048,
-        num_layers=10, num_attention_heads=16, num_kv_heads=16,
-        ffn_hidden_size=5504, params_dtype="bfloat16",
-        attention_impl="pallas",
-    )
-    opt_cfg = OptimizerConfig(lr=1e-4, lr_decay_style="constant")
-    tcfg = TrainingConfig(micro_batch_size=micro_bs,
-                          global_batch_size=micro_bs,
-                          recompute_granularity=granularity, seed=0)
-    rng = np.random.default_rng(0)
-    batch = {
-        "tokens": jnp.asarray(
-            rng.integers(0, cfg.vocab_size, (micro_bs, seq_length)), jnp.int32),
-        "labels": jnp.asarray(
-            rng.integers(0, cfg.vocab_size, (micro_bs, seq_length)), jnp.int32),
-        "loss_mask": jnp.ones((micro_bs, seq_length), jnp.float32),
-    }
-    params = init_params(cfg, jax.random.PRNGKey(0))
-    state = init_train_state(opt_cfg, params)
-    step = jax.jit(
-        make_train_step(cfg, opt_cfg, tcfg, num_microbatches=1,
-                        train_iters=1000),
-        donate_argnums=(0,),
-    )
-    try:
-        state, metrics = step(state, batch)
-        float(metrics["loss"])
-        state, metrics = step(state, batch)
-        float(metrics["loss"])
-    except Exception as e:
-        if "RESOURCE_EXHAUSTED" in str(e) or "memory" in str(e).lower():
-            return {"micro_bs": micro_bs, "recompute": granularity,
-                    "oom": True}
-        raise
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        state, metrics = step(state, batch)
-    float(metrics["loss"])
-    dt = (time.perf_counter() - t0) / iters
 
     from megatron_tpu.platform import peak_bf16_flops
 
+    cfg = headline_config(seq_length=seq_length)
+    state, step, batch = build_step(cfg, micro_bs, granularity)
+    try:
+        dt, _, state = time_step(state, step, batch, iters=iters)
+    except Exception as e:
+        if is_oom(e):
+            return {"micro_bs": micro_bs, "recompute": granularity,
+                    "oom": True}
+        raise
     tokens_per_sec = micro_bs * seq_length / dt
     achieved = tokens_per_sec * 3.0 * cfg.flops_per_token_fwd()
     peak = peak_bf16_flops(jax.devices()[0])
