@@ -113,16 +113,24 @@ def main():
     for granularity in ("none", "selective"):
         state, step, batch = build_step(cfg, micro_bs, granularity)
         profile_dir = os.environ.get("MEGATRON_TPU_PROFILE_DIR")
+        profiling = False
         try:
             if profile_dir:
-                _ = time_step(state, step, batch, iters=1)  # compile first
+                # compile + warm up before the trace; the step donates its
+                # state, so thread the returned state into the timed loop
+                _, _, state = time_step(state, step, batch, iters=1)
                 jax.profiler.start_trace(profile_dir)
+                profiling = True
             dt, loss_val, state = time_step(state, step, batch)
-            if profile_dir:
+            if profiling:
                 jax.profiler.stop_trace()
+                profiling = False
             result = (granularity, dt, loss_val)
             break
         except Exception as e:  # XlaRuntimeError OOM etc.
+            if profiling:
+                jax.profiler.stop_trace()
+                profiling = False
             if not is_oom(e):
                 raise
             del state, step  # free the failed attempt before the fallback

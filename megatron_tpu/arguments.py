@@ -11,6 +11,7 @@ MicroBatchCalculator at use sites.
 from __future__ import annotations
 
 import argparse
+import os
 from typing import Optional, Sequence
 
 from megatron_tpu.config import (
@@ -50,7 +51,13 @@ def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
     g.add_argument("--parallel_attn", action="store_true")
     g.add_argument("--parallel_layernorm", action="store_true")
     g.add_argument("--use_bias", action="store_true")
-    g.add_argument("--tie_embed_logits", action="store_true")
+    # ref polarity: tied is the default, --no_tie_embed_logits unties
+    # (llama presets set their own untied value regardless)
+    g.add_argument("--tie_embed_logits", action="store_true", default=None)
+    g.add_argument("--no_tie_embed_logits", action="store_false",
+                   dest="tie_embed_logits",
+                   help="untie the word embedding and lm head (ref default "
+                        "is tied)")
     g.add_argument("--sliding_window_size", type=int, default=None)
     g.add_argument("--lima_dropout", action="store_true")
     g.add_argument("--encoder_seq_length", type=int, default=None,
@@ -170,6 +177,9 @@ def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
     g.add_argument("--local_rank", type=int, default=None,
                    help="accepted for torchrun-script compat; process "
                         "identity comes from jax.distributed here")
+    g.add_argument("--DDP_impl", default="local", choices=["local", "torch"],
+                   help="accepted for script compat; gradient reduction is "
+                        "XLA data sharding either way")
 
     g = p.add_argument_group("validation")
     g.add_argument("--eval_interval", type=int, default=1000)
@@ -198,6 +208,9 @@ def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
                    help="ref spelling of --merges_file")
     g.add_argument("--tokenizer_model", default=None)
     g.add_argument("--vocab_extra_ids", type=int, default=None)
+    g.add_argument("--no_new_tokens", action="store_false", dest="new_tokens",
+                   help="do not add special/extra-id tokens in the "
+                        "sentencepiece tokenizer")
     g.add_argument("--data_cache_dir", default=None)
     g.add_argument("--scalar_loss_mask", type=float, default=0.0)
     g.add_argument("--variable_seq_lengths", action="store_true")
@@ -221,6 +234,8 @@ def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
     g.add_argument("--wandb_logger", action="store_true")
     g.add_argument("--wandb_project", default="megatron_tpu")
     g.add_argument("--wandb_name", default=None)
+    g.add_argument("--wandb_api_key", default=None,
+                   help="exported as WANDB_API_KEY if not already set")
     g.add_argument("--timing_log_level", type=int, default=0)
     g.add_argument("--log_num_zeros_in_grad", action="store_true")
     g.add_argument("--log_params_norm", action="store_true")
@@ -298,6 +313,8 @@ def args_to_run_config(args) -> RunConfig:
         overrides["lima_dropout"] = args.lima_dropout
         overrides["attention_impl"] = args.attention_impl
         overrides["params_dtype"] = _dtype_name(args)
+        if args.tie_embed_logits is not None:  # explicit (no_)tie flag
+            overrides["tie_embed_logits"] = args.tie_embed_logits
         model = ModelConfig(**{**model.__dict__, **overrides}).validate()
     else:
         required = ["num_layers", "hidden_size", "num_attention_heads"]
@@ -328,7 +345,9 @@ def args_to_run_config(args) -> RunConfig:
             parallel_layernorm=args.parallel_layernorm,
             use_bias_linear=args.use_bias,
             use_bias_qkv=args.use_bias,
-            tie_embed_logits=args.tie_embed_logits,
+            # ref default is tied (untie with --no_tie_embed_logits)
+            tie_embed_logits=(True if args.tie_embed_logits is None
+                              else args.tie_embed_logits),
             sliding_window_size=args.sliding_window_size,
             use_post_ln=args.use_post_ln,
             apply_residual_post_ln=args.apply_residual_connection_post_layernorm,
@@ -380,6 +399,9 @@ def args_to_run_config(args) -> RunConfig:
         loss_scale_window=args.loss_scale_window,
         hysteresis=args.hysteresis,
     )
+
+    if getattr(args, "wandb_api_key", None) and "WANDB_API_KEY" not in os.environ:
+        os.environ["WANDB_API_KEY"] = args.wandb_api_key
 
     training = TrainingConfig(
         micro_batch_size=args.micro_batch_size,

@@ -59,7 +59,9 @@ def main(argv=None):
     cfg = dataclasses.replace(cfg, model=model)
 
     tok = build_tokenizer(args.tokenizer_type, vocab_size=cfg.model.vocab_size,
-                          tokenizer_model=getattr(args, "tokenizer_model", None))
+                          tokenizer_model=getattr(args, "tokenizer_model", None),
+                          vocab_extra_ids=args.vocab_extra_ids or 0,
+                          new_tokens=args.new_tokens)
     ids = dict(cls_id=args.cls_token_id, sep_id=args.sep_token_id,
                pad_id=args.pad_token_id)
 

@@ -198,7 +198,9 @@ def _local_generate_fn(args):
     tok = build_tokenizer(args.tokenizer_type, vocab_size=cfg.model.vocab_size,
                           tokenizer_model=args.tokenizer_model,
                           vocab_file=args.vocab_file,
-                          merges_file=getattr(args, "merges_file", None))
+                          merges_file=getattr(args, "merges_file", None),
+                          vocab_extra_ids=args.vocab_extra_ids or 0,
+                          new_tokens=args.new_tokens)
     params = init_params(cfg.model, jax.random.PRNGKey(cfg.training.seed))
     if cfg.training.load:
         params = checkpointing.load_params_only(cfg.training.load, params)

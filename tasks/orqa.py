@@ -139,7 +139,9 @@ def main(argv=None):
 
     tok = build_tokenizer(args.tokenizer_type, vocab_size=model.vocab_size,
                           tokenizer_model=args.tokenizer_model,
-                          vocab_file=args.vocab_file)
+                          vocab_file=args.vocab_file,
+                          vocab_extra_ids=args.vocab_extra_ids or 0,
+                          new_tokens=args.new_tokens)
 
     index = np.load(os.path.join(args.index_dir, "block_index.npy"))
     meta = np.load(os.path.join(args.index_dir, "block_meta.npy"))

@@ -1,0 +1,47 @@
+"""CLI flag-parity behaviors (counterpart: reference megatron/arguments.py
+defaults that scripts rely on)."""
+
+import os
+
+from megatron_tpu.arguments import args_to_run_config, parse_args
+
+BASE = ["--num_layers", "2", "--hidden_size", "32",
+        "--num_attention_heads", "4", "--seq_length", "32",
+        "--vocab_size", "128", "--micro_batch_size", "1",
+        "--global_batch_size", "1"]
+
+
+def test_tie_embed_logits_defaults_tied_like_reference():
+    cfg = args_to_run_config(parse_args(BASE))
+    assert cfg.model.tie_embed_logits is True
+
+
+def test_no_tie_embed_logits_unties():
+    cfg = args_to_run_config(parse_args(BASE + ["--no_tie_embed_logits"]))
+    assert cfg.model.tie_embed_logits is False
+
+
+def test_tie_embed_logits_explicit_flag_still_ties():
+    cfg = args_to_run_config(parse_args(BASE + ["--tie_embed_logits"]))
+    assert cfg.model.tie_embed_logits is True
+
+
+def test_ddp_impl_accepted_for_script_compat():
+    args = parse_args(BASE + ["--DDP_impl", "local"])
+    assert args.DDP_impl == "local"
+    args_to_run_config(args)  # no error; reduction is XLA either way
+
+
+def test_no_new_tokens_parsed():
+    args = parse_args(BASE + ["--no_new_tokens"])
+    assert args.new_tokens is False
+    assert parse_args(BASE).new_tokens is True
+
+
+def test_wandb_api_key_exported(monkeypatch):
+    monkeypatch.delenv("WANDB_API_KEY", raising=False)
+    args_to_run_config(parse_args(BASE + ["--wandb_api_key", "k-test"]))
+    assert os.environ.get("WANDB_API_KEY") == "k-test"
+    monkeypatch.setenv("WANDB_API_KEY", "preexisting")
+    args_to_run_config(parse_args(BASE + ["--wandb_api_key", "k-other"]))
+    assert os.environ["WANDB_API_KEY"] == "preexisting"

@@ -38,6 +38,8 @@ def _init_worker(args):
         tokenizer_model=args.tokenizer_model,
         name_or_path=args.tokenizer_name_or_path,
         vocab_size=args.vocab_size,
+        vocab_extra_ids=args.vocab_extra_ids,
+        new_tokens=args.new_tokens,
     )
 
 
@@ -67,6 +69,11 @@ def get_args(argv=None):
     p.add_argument("--tokenizer_model", default=None)
     p.add_argument("--tokenizer_name_or_path", default=None)
     p.add_argument("--vocab_size", type=int, default=None)
+    p.add_argument("--vocab_extra_ids", type=int, default=0)
+    p.add_argument("--no_new_tokens", action="store_false",
+                   dest="new_tokens",
+                   help="do not add special/extra-id tokens in the "
+                        "sentencepiece tokenizer")
     p.add_argument("--workers", type=int, default=1)
     p.add_argument("--log_interval", type=int, default=10000)
     return p.parse_args(argv)

@@ -38,6 +38,11 @@ def get_args(argv=None):
     p.add_argument("--tokenizer_model", default=None)
     p.add_argument("--tokenizer_name_or_path", default=None)
     p.add_argument("--vocab_size", type=int, default=None)
+    p.add_argument("--vocab_extra_ids", type=int, default=0)
+    p.add_argument("--no_new_tokens", action="store_false",
+                   dest="new_tokens",
+                   help="do not add special/extra-id tokens in the "
+                        "sentencepiece tokenizer")
     p.add_argument("--conversation_key", default="conversation")
     p.add_argument("--append_eod", action="store_true")
     return p.parse_args(argv)
@@ -52,6 +57,8 @@ def main(argv=None):
         tokenizer_model=args.tokenizer_model,
         name_or_path=args.tokenizer_name_or_path,
         vocab_size=args.vocab_size,
+        vocab_extra_ids=args.vocab_extra_ids,
+        new_tokens=args.new_tokens,
     )
     text_prefix = args.output_prefix + "-text"
     role_prefix = args.output_prefix + "-role"

@@ -39,7 +39,9 @@ def main(argv=None):
     tokenizer = build_tokenizer(
         args.tokenizer_type, vocab_file=args.vocab_file,
         merges_file=args.merges_file, tokenizer_model=args.tokenizer_model,
-        vocab_size=args.vocab_size)
+        vocab_size=args.vocab_size,
+        vocab_extra_ids=args.vocab_extra_ids or 0,
+        new_tokens=args.new_tokens)
 
     params = init_params(cfg.model, jax.random.PRNGKey(cfg.training.seed))
     if cfg.training.load:
