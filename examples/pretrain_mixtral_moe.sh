@@ -1,0 +1,33 @@
+#!/bin/bash
+# Mixtral-8x7B-class MoE pretraining (beyond the reference: epfLLM has no
+# MoE). Experts shard over the data axis (expert parallelism) and
+# tensor-parallel inside each expert; top-2 renormalized routing with the
+# Switch load-balance loss.
+#
+# On a v5p-128 slice: dp16 x tp8, 8 experts -> each dp group holds one
+# expert shard half. Scale --num_experts/--moe_capacity_factor to taste.
+
+python pretrain_gpt.py \
+    --model_name mixtral \
+    --tensor_model_parallel_size 8 \
+    --sequence_parallel \
+    --use_distributed_optimizer \
+    --num_experts 8 \
+    --moe_top_k 2 \
+    --moe_capacity_factor 1.25 \
+    --moe_aux_loss_coeff 0.01 \
+    --micro_batch_size 1 \
+    --global_batch_size 256 \
+    --seq_length 8192 \
+    --train_iters 100000 \
+    --lr 3e-4 --min_lr 3e-5 --lr_decay_style cosine \
+    --lr_warmup_iters 2000 \
+    --clip_grad 1.0 \
+    --bf16 \
+    --recompute_granularity selective \
+    --data_path data/corpus \
+    --tokenizer_type SentencePieceTokenizer \
+    --tokenizer_model tokenizer.model \
+    --save ckpts/mixtral --save_interval 1000 \
+    --log_interval 10 \
+    "$@"

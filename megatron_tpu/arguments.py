@@ -59,6 +59,19 @@ def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
                    help="untie the word embedding and lm head (ref default "
                         "is tied)")
     g.add_argument("--sliding_window_size", type=int, default=None)
+    # MoE (beyond the reference; see ops/moe.py). Defaults are None so an
+    # explicitly-passed knob overrides a preset's value but an unpassed
+    # knob never clobbers it (the mixtral preset carries its own values).
+    g.add_argument("--num_experts", type=int, default=None)
+    g.add_argument("--moe_top_k", type=int, default=None)
+    g.add_argument("--moe_capacity_factor", type=float, default=None)
+    g.add_argument("--moe_aux_loss_coeff", type=float, default=None)
+    g.add_argument("--moe_z_loss_coeff", type=float, default=None)
+    g.add_argument("--moe_renorm_gates", action="store_true", default=None)
+    g.add_argument("--no_moe_renorm_gates", action="store_false",
+                   dest="moe_renorm_gates",
+                   help="use raw softmax gate values (GShard) instead of "
+                        "renormalized top-k weights (Mixtral)")
     g.add_argument("--lima_dropout", action="store_true")
     g.add_argument("--encoder_seq_length", type=int, default=None,
                    help="alias of --seq_length (ref derives one from the other)")
@@ -253,6 +266,19 @@ def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
     return p
 
 
+def _moe_overrides(args) -> dict:
+    """MoE knobs that were explicitly passed (None = flag absent, keep the
+    preset's or ModelConfig's value)."""
+    out = {}
+    for name in ("num_experts", "moe_top_k", "moe_capacity_factor",
+                 "moe_aux_loss_coeff", "moe_z_loss_coeff",
+                 "moe_renorm_gates"):
+        v = getattr(args, name, None)
+        if v is not None:
+            out[name] = v
+    return out
+
+
 def args_to_run_config(args) -> RunConfig:
     from megatron_tpu.models import presets
     from megatron_tpu.tokenizer import pad_vocab_size
@@ -315,6 +341,7 @@ def args_to_run_config(args) -> RunConfig:
         overrides["params_dtype"] = _dtype_name(args)
         if args.tie_embed_logits is not None:  # explicit (no_)tie flag
             overrides["tie_embed_logits"] = args.tie_embed_logits
+        overrides.update(_moe_overrides(args))
         model = ModelConfig(**{**model.__dict__, **overrides}).validate()
     else:
         required = ["num_layers", "hidden_size", "num_attention_heads"]
@@ -348,6 +375,7 @@ def args_to_run_config(args) -> RunConfig:
             # ref default is tied (untie with --no_tie_embed_logits)
             tie_embed_logits=(True if args.tie_embed_logits is None
                               else args.tie_embed_logits),
+            **_moe_overrides(args),
             sliding_window_size=args.sliding_window_size,
             use_post_ln=args.use_post_ln,
             apply_residual_post_ln=args.apply_residual_connection_post_layernorm,

@@ -106,6 +106,16 @@ class ModelConfig:
     # Mistral sliding-window attention (ref: transformer.py:528-536)
     sliding_window_size: Optional[int] = None
 
+    # Mixture-of-Experts (beyond the reference): GShard/Switch einsum
+    # dispatch with capacity; Mixtral-style renormalized top-k gates.
+    # None = dense MLP. See ops/moe.py.
+    num_experts: Optional[int] = None
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_loss_coeff: float = 1e-2
+    moe_z_loss_coeff: float = 0.0
+    moe_renorm_gates: bool = True
+
     # regularization
     hidden_dropout: float = 0.0
     attention_dropout: float = 0.0
@@ -183,6 +193,13 @@ class ModelConfig:
             raise ValueError("absolute position embeddings need max_position_embeddings")
         if self.parallel_layernorm and not self.parallel_attn:
             raise ValueError("parallel_layernorm requires parallel_attn")
+        if self.num_experts is not None:
+            if self.num_experts < 1:
+                raise ValueError("num_experts must be >= 1")
+            if not 1 <= self.moe_top_k <= self.num_experts:
+                raise ValueError(
+                    f"moe_top_k={self.moe_top_k} must be in "
+                    f"[1, num_experts={self.num_experts}]")
         return self
 
     # FLOPs per token for one fwd pass, used for MFU accounting
@@ -197,7 +214,11 @@ class ModelConfig:
         per_layer += 2 * 2 * s * nq * hd                # qk^T and av (causal ~ /2 but count full)
         per_layer += 2 * nq * hd * h                    # out proj
         mlp_in_width = f * (2 if self.is_glu else 1)
-        per_layer += 2 * h * mlp_in_width + 2 * f * h   # mlp
+        mlp = 2 * h * mlp_in_width + 2 * f * h
+        if self.num_experts is not None:
+            # each token visits top_k experts; router matmul is extra
+            mlp = mlp * self.moe_top_k + 2 * h * self.num_experts
+        per_layer += mlp
         total = self.num_layers * per_layer
         total += 2 * h * self.vocab_size                # logits
         return float(total)

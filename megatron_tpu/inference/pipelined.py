@@ -66,7 +66,7 @@ def make_pipelined_lm_forward(cfg: ModelConfig, mesh: Mesh, num_stages: int):
 
                 def lbody(x, sc):
                     lp, k1, v1 = sc
-                    y, new_kv = block_forward(
+                    y, new_kv, _ = block_forward(
                         cfg, lp, x, rope, positions,
                         kv_cache=(k1, v1), cache_index=cache_index)
                     return y, new_kv

@@ -16,8 +16,8 @@ reference:
 All mappings operate on numpy arrays keyed by HF state-dict names; torch is
 only touched to read/write HF checkpoints at the edges.
 
-Supported architectures: llama (v1/v2/codellama), mistral, falcon (7B/40B),
-gpt2.
+Supported architectures: llama (v1/v2/codellama), mistral, mixtral (MoE),
+falcon (7B/40B), gpt2.
 """
 
 from __future__ import annotations
@@ -57,11 +57,24 @@ def _nest_set(tree: Dict[str, Any], path: str, value: np.ndarray) -> None:
 def config_from_hf(hf_config, seq_length: int = None) -> ModelConfig:
     """Build a ModelConfig from a transformers PretrainedConfig."""
     mt = hf_config.model_type
-    if mt in ("llama", "mistral"):
+    if mt in ("llama", "mistral", "mixtral"):
         rope_scaling = getattr(hf_config, "rope_scaling", None) or {}
         if rope_scaling and rope_scaling.get("rope_type", rope_scaling.get("type")) != "linear":
             raise ValueError(f"unsupported rope_scaling {rope_scaling!r} (only linear)")
+        moe = {}
+        if mt == "mixtral":
+            moe = dict(
+                num_experts=hf_config.num_local_experts,
+                moe_top_k=hf_config.num_experts_per_tok,
+                moe_renorm_gates=True,
+                moe_aux_loss_coeff=getattr(hf_config,
+                                           "router_aux_loss_coef", 1e-2),
+                # HF Mixtral is dropless; ample capacity preserves its
+                # semantics exactly (tune down for training throughput)
+                moe_capacity_factor=float(hf_config.num_local_experts),
+            )
         return ModelConfig(
+            **moe,
             num_layers=hf_config.num_hidden_layers,
             hidden_size=hf_config.hidden_size,
             num_attention_heads=hf_config.num_attention_heads,
@@ -77,7 +90,7 @@ def config_from_hf(hf_config, seq_length: int = None) -> ModelConfig:
             layernorm_epsilon=hf_config.rms_norm_eps,
             tie_embed_logits=getattr(hf_config, "tie_word_embeddings", False),
             sliding_window_size=getattr(hf_config, "sliding_window", None)
-            if mt == "mistral" else None,
+            if mt in ("mistral", "mixtral") else None,
         ).validate()
     if mt == "falcon":
         new_arch = getattr(hf_config, "new_decoder_architecture", False)
@@ -127,7 +140,7 @@ def hf_config_from_native(cfg: ModelConfig, model_type: str):
     """Inverse of config_from_hf — build a transformers config so converted
     weights can be loaded/saved with HF tooling
     (ref: megatron_to_hf.py writes config.json per arch)."""
-    if model_type in ("llama", "mistral"):
+    if model_type in ("llama", "mistral", "mixtral"):
         common = dict(
             vocab_size=cfg.vocab_size,
             hidden_size=cfg.hidden_size,
@@ -147,6 +160,15 @@ def hf_config_from_native(cfg: ModelConfig, model_type: str):
                 common["rope_scaling"] = {"rope_type": "linear",
                                           "factor": cfg.rope_scaling_factor}
             return LlamaConfig(**common)
+        if model_type == "mixtral":
+            from transformers import MixtralConfig
+
+            return MixtralConfig(
+                sliding_window=cfg.sliding_window_size,
+                num_local_experts=cfg.num_experts,
+                num_experts_per_tok=cfg.moe_top_k,
+                router_aux_loss_coef=cfg.moe_aux_loss_coeff,
+                **common)
         from transformers import MistralConfig
 
         return MistralConfig(sliding_window=cfg.sliding_window_size, **common)
@@ -209,13 +231,30 @@ def _llama_to_params(sd: Dict[str, Any], cfg: ModelConfig) -> Dict[str, Any]:
               _stack(sd, "model.layers.{}.self_attn.v_proj.weight", L, T))
     _nest_set(p, "layers/attn/wo",
               _stack(sd, "model.layers.{}.self_attn.o_proj.weight", L, T))
-    w_in = np.concatenate([
-        _stack(sd, "model.layers.{}.mlp.gate_proj.weight", L, T),
-        _stack(sd, "model.layers.{}.mlp.up_proj.weight", L, T),
-    ], axis=-1)
-    _nest_set(p, "layers/mlp/w_in", w_in)
-    _nest_set(p, "layers/mlp/w_out",
-              _stack(sd, "model.layers.{}.mlp.down_proj.weight", L, T))
+    if cfg.num_experts is None:
+        w_in = np.concatenate([
+            _stack(sd, "model.layers.{}.mlp.gate_proj.weight", L, T),
+            _stack(sd, "model.layers.{}.mlp.up_proj.weight", L, T),
+        ], axis=-1)
+        _nest_set(p, "layers/mlp/w_in", w_in)
+        _nest_set(p, "layers/mlp/w_out",
+                  _stack(sd, "model.layers.{}.mlp.down_proj.weight", L, T))
+    else:
+        # Mixtral block_sparse_moe: gate router + per-expert w1(gate)/
+        # w3(up)/w2(down) -> router [L,H,E], w_in [L,E,H,2F], w_out [L,E,F,H]
+        E = cfg.num_experts
+        moe = "model.layers.{}.block_sparse_moe"
+        _nest_set(p, "layers/moe/router",
+                  _stack(sd, moe + ".gate.weight", L, T))
+        ex = moe + ".experts.{}"
+        _nest_set(p, "layers/moe/w_in", np.stack([np.stack([
+            np.concatenate([
+                T(_to_numpy(sd[(ex + ".w1.weight").format(i, e)])),
+                T(_to_numpy(sd[(ex + ".w3.weight").format(i, e)])),
+            ], axis=-1) for e in range(E)]) for i in range(L)]))
+        _nest_set(p, "layers/moe/w_out", np.stack([np.stack([
+            T(_to_numpy(sd[(ex + ".w2.weight").format(i, e)]))
+            for e in range(E)]) for i in range(L)]))
     _nest_set(p, "final_ln/scale", _to_numpy(sd["model.norm.weight"]))
     if not cfg.tie_embed_logits:
         _nest_set(p, "lm_head/w", T(_to_numpy(sd["lm_head.weight"])))
@@ -310,6 +349,7 @@ def _gpt2_to_params(sd: Dict[str, Any], cfg: ModelConfig) -> Dict[str, Any]:
 _IMPORTERS = {
     "llama": _llama_to_params,
     "mistral": _llama_to_params,
+    "mixtral": _llama_to_params,  # shares attn/norms; MoE branch inside
     "falcon": _falcon_to_params,
     "gpt2": _gpt2_to_params,
 }
@@ -375,7 +415,7 @@ def params_to_hf_state_dict(
     L = cfg.num_layers
     sd: Dict[str, np.ndarray] = {}
     T = lambda x: np.ascontiguousarray(x.T)
-    if model_type in ("llama", "mistral"):
+    if model_type in ("llama", "mistral", "mixtral"):
         sd["model.embed_tokens.weight"] = f["embed/tokens"]
         for i in range(L):
             pre = f"model.layers.{i}"
@@ -385,11 +425,21 @@ def params_to_hf_state_dict(
             sd[f"{pre}.self_attn.k_proj.weight"] = T(f["layers/attn/wk"][i])
             sd[f"{pre}.self_attn.v_proj.weight"] = T(f["layers/attn/wv"][i])
             sd[f"{pre}.self_attn.o_proj.weight"] = T(f["layers/attn/wo"][i])
-            w_in = f["layers/mlp/w_in"][i]
-            gate, up = np.split(w_in, 2, axis=-1)
-            sd[f"{pre}.mlp.gate_proj.weight"] = T(gate)
-            sd[f"{pre}.mlp.up_proj.weight"] = T(up)
-            sd[f"{pre}.mlp.down_proj.weight"] = T(f["layers/mlp/w_out"][i])
+            if cfg.num_experts is None:
+                w_in = f["layers/mlp/w_in"][i]
+                gate, up = np.split(w_in, 2, axis=-1)
+                sd[f"{pre}.mlp.gate_proj.weight"] = T(gate)
+                sd[f"{pre}.mlp.up_proj.weight"] = T(up)
+                sd[f"{pre}.mlp.down_proj.weight"] = T(f["layers/mlp/w_out"][i])
+            else:
+                moe = f"{pre}.block_sparse_moe"
+                sd[f"{moe}.gate.weight"] = T(f["layers/moe/router"][i])
+                for e in range(cfg.num_experts):
+                    gate, up = np.split(f["layers/moe/w_in"][i][e], 2, axis=-1)
+                    sd[f"{moe}.experts.{e}.w1.weight"] = T(gate)
+                    sd[f"{moe}.experts.{e}.w3.weight"] = T(up)
+                    sd[f"{moe}.experts.{e}.w2.weight"] = T(
+                        f["layers/moe/w_out"][i][e])
         sd["model.norm.weight"] = f["final_ln/scale"]
         if not cfg.tie_embed_logits:
             sd["lm_head.weight"] = T(f["lm_head/w"])

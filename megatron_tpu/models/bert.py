@@ -41,7 +41,14 @@ def bert_config(
         hidden_dropout=0.1, attention_dropout=0.1,
     )
     base.update(kw)
-    return ModelConfig(**base).validate()
+    cfg = ModelConfig(**base).validate()
+    if cfg.num_experts is not None:
+        # the task-head losses (MLM/classification/biencoder) don't carry
+        # the router aux loss yet; failing beats silently untrained routing
+        raise NotImplementedError(
+            "MoE backbones are supported for the decoder (GPT) family "
+            "only; encoder task heads would drop the router losses")
+    return cfg
 
 
 def bert_forward(

@@ -104,6 +104,7 @@ def lm_forward(
     kv_caches: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,  # [L,B,Smax,nkv,D] x2
     cache_index=None,
     return_hidden: bool = False,
+    return_moe_aux: bool = False,
     attention_mask: Optional[jnp.ndarray] = None,  # [B, S] True = attend
     tokentype_ids: Optional[jnp.ndarray] = None,   # [B, S] (BERT segments)
 ):
@@ -137,10 +138,10 @@ def lm_forward(
     rates = _layer_dropout_rates(cfg)
 
     def body(carry, scanned):
-        x = carry
+        x, aux = carry
         lp, rate, idx, caches = scanned
         key = jax.random.fold_in(dropout_key, idx) if train else None
-        y, new_cache = block_forward(
+        y, new_cache, moe_aux = block_forward(
             cfg, lp, x, rope, positions,
             dropout_key=key,
             hidden_dropout_rate=rate,
@@ -149,7 +150,7 @@ def lm_forward(
             sharder=sharder,
             padding_mask=attention_mask,
         )
-        return y, new_cache
+        return (y, aux + moe_aux), new_cache
 
     policy = _remat_policy(recompute)
     if policy is not None:
@@ -157,14 +158,22 @@ def lm_forward(
 
     layer_idx = jnp.arange(cfg.num_layers)
     xs = (params["layers"], rates, layer_idx, kv_caches)
-    x, new_caches = jax.lax.scan(body, x, xs)
+    (x, moe_aux), new_caches = jax.lax.scan(
+        body, (x, jnp.zeros((), jnp.float32)), xs)
 
     x = final_hidden_norm(cfg, params, x)
     if return_hidden:
-        return x
+        # MoE backbones under task heads (BERT/classification/biencoder)
+        # must not silently drop the router losses
+        return (x, moe_aux) if return_moe_aux else x
 
     logits = lm_logits(cfg, params, x)
     logits = sharder(logits, "logits")
+    if return_moe_aux and kv_caches is not None:
+        raise ValueError("return_moe_aux with kv_caches is ambiguous — "
+                         "decode paths don't train the router")
+    if return_moe_aux:
+        return logits, moe_aux
     if kv_caches is not None:
         return logits, new_caches
     return logits
@@ -184,15 +193,24 @@ def lm_loss(
     Matches the reference contract: per-token CE weighted by loss_mask
     (gpt_model.py post_language_model_processing + finetune.py loss_func).
     """
-    logits = lm_forward(
+    moe = cfg.num_experts is not None
+    out = lm_forward(
         cfg, params, batch["tokens"],
         positions=batch.get("position_ids"),
         dropout_key=dropout_key,
         recompute=recompute,
         sharder=sharder,
+        return_moe_aux=moe,
     )
+    logits, moe_aux = out if moe else (out, None)
     mean, per_token = cross_entropy_loss(
         logits, batch["labels"], loss_mask=batch.get("loss_mask"))
     ntokens = (jnp.sum(batch["loss_mask"]) if "loss_mask" in batch
                else jnp.asarray(per_token.size, jnp.float32))
-    return mean, {"lm_loss": mean, "ntokens": ntokens}
+    aux = {"lm_loss": mean, "ntokens": ntokens}
+    if moe:
+        # router losses train alongside CE (Switch eq. 4 / ST-MoE z-loss);
+        # lm_loss in metrics stays the pure CE term
+        aux["moe_aux_loss"] = moe_aux
+        return mean + moe_aux, aux
+    return mean, aux

@@ -27,7 +27,7 @@ from jax.sharding import PartitionSpec as P
 
 from megatron_tpu.config import ModelConfig
 from megatron_tpu.ops.activations import mlp_input_width_factor
-from megatron_tpu.parallel.mesh import AXIS_PIPE, AXIS_TENSOR
+from megatron_tpu.parallel.mesh import AXIS_DATA, AXIS_PIPE, AXIS_TENSOR
 
 # init kinds
 _NORMAL = "normal"          # N(0, init_method_std)
@@ -77,11 +77,30 @@ def _defs(cfg: ModelConfig) -> Dict[str, Any]:
     if cfg.use_bias_linear:
         d["layers/attn/bo"] = ((L, h), P(AXIS_PIPE, None), _ZEROS)
 
-    d["layers/mlp/w_in"] = ((L, h, Fin), P(AXIS_PIPE, None, AXIS_TENSOR), _NORMAL)
-    d["layers/mlp/w_out"] = ((L, F, h), P(AXIS_PIPE, AXIS_TENSOR, None), _SCALED)
-    if cfg.use_bias_linear:
-        d["layers/mlp/b_in"] = ((L, Fin), P(AXIS_PIPE, AXIS_TENSOR), _ZEROS)
-        d["layers/mlp/b_out"] = ((L, h), P(AXIS_PIPE, None), _ZEROS)
+    if cfg.num_experts is None:
+        d["layers/mlp/w_in"] = ((L, h, Fin), P(AXIS_PIPE, None, AXIS_TENSOR), _NORMAL)
+        d["layers/mlp/w_out"] = ((L, F, h), P(AXIS_PIPE, AXIS_TENSOR, None), _SCALED)
+        if cfg.use_bias_linear:
+            d["layers/mlp/b_in"] = ((L, Fin), P(AXIS_PIPE, AXIS_TENSOR), _ZEROS)
+            d["layers/mlp/b_out"] = ((L, h), P(AXIS_PIPE, None), _ZEROS)
+    else:
+        # experts sharded over the data axis (expert parallelism: each dp
+        # group holds E/dp experts; GSPMD inserts the dispatch all-to-all)
+        # and tensor-parallel inside each expert, composing EP x TP
+        E = cfg.num_experts
+        d["layers/moe/router"] = ((L, h, E), P(AXIS_PIPE, None, None), _NORMAL)
+        d["layers/moe/w_in"] = ((L, E, h, Fin),
+                                P(AXIS_PIPE, AXIS_DATA, None, AXIS_TENSOR),
+                                _NORMAL)
+        d["layers/moe/w_out"] = ((L, E, F, h),
+                                 P(AXIS_PIPE, AXIS_DATA, AXIS_TENSOR, None),
+                                 _SCALED)
+        if cfg.use_bias_linear:
+            d["layers/moe/b_in"] = ((L, E, Fin),
+                                    P(AXIS_PIPE, AXIS_DATA, AXIS_TENSOR),
+                                    _ZEROS)
+            d["layers/moe/b_out"] = ((L, E, h),
+                                     P(AXIS_PIPE, AXIS_DATA, None), _ZEROS)
 
     if not cfg.use_post_ln:  # post-LN layers carry their own output norm
         d["final_ln/scale"] = ((h,), P(None), _ONES)

@@ -99,6 +99,19 @@ def mistral(size: str = "7B", seq_length: int = 8192) -> ModelConfig:
     )
 
 
+def mixtral(size: str = "8x7B", seq_length: int = 8192) -> ModelConfig:
+    """Mixtral-8x7B: Mistral geometry with 8 experts / top-2 renormalized
+    routing per layer (beyond the reference — no MoE upstream; routing
+    semantics match HF MixtralSparseMoeBlock when capacity is ample)."""
+    assert size == "8x7B"
+    return _llama_base(
+        hidden_size=4096, num_layers=32, num_attention_heads=32,
+        num_kv_heads=8, ffn_hidden_size=14336, seq_length=seq_length,
+        num_experts=8, moe_top_k=2, moe_renorm_gates=True,
+        rope_theta=1e6,  # Mixtral-8x7B config (vs llama/mistral 1e4)
+    )
+
+
 def falcon(size: str = "7B", seq_length: int = 2048) -> ModelConfig:
     """Falcon 7B/40B: rotary, MQA/GQA, parallel attention, layernorm, gelu,
     tied embeddings, no linear biases (ref: megatron/model/falcon_model.py)."""
@@ -160,6 +173,7 @@ PRESETS = {
     "llama2": lambda **kw: llama(version=2, **kw),
     "codellama": codellama,
     "mistral": mistral,
+    "mixtral": mixtral,
     "falcon": falcon,
     "gpt2": gpt2,
     "tiny": tiny,

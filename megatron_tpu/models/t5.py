@@ -47,7 +47,12 @@ def t5_config(
         tie_embed_logits=True, attn_mask_type="padding",
     )
     base.update(kw)
-    return ModelConfig(**base).validate()
+    cfg = ModelConfig(**base).validate()
+    if cfg.num_experts is not None:
+        raise NotImplementedError(
+            "MoE is supported for the decoder (GPT) family only; the T5 "
+            "stacks use their own dense MLP parameter tree")
+    return cfg
 
 
 # ---------------------------------------------------------------------------

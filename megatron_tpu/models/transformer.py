@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from megatron_tpu.config import ModelConfig
 from megatron_tpu.ops.activations import apply_activation
 from megatron_tpu.ops.attention import attention
+from megatron_tpu.ops.moe import moe_block
 from megatron_tpu.ops.normalization import norm_forward
 from megatron_tpu.ops.rotary import apply_rotary_emb
 
@@ -123,6 +124,13 @@ def mlp_block(cfg: ModelConfig, p: Dict[str, Any], x: jnp.ndarray) -> jnp.ndarra
     return out
 
 
+def _ffn(cfg: ModelConfig, lp: Dict[str, Any], x: jnp.ndarray):
+    """Dense MLP or MoE, by config. Returns (out, aux_loss fp32 scalar)."""
+    if cfg.num_experts is not None:
+        return moe_block(cfg, lp["moe"], x)
+    return mlp_block(cfg, lp["mlp"], x), jnp.zeros((), jnp.float32)
+
+
 def block_forward(
     cfg: ModelConfig,
     lp: Dict[str, Any],  # one layer's params (unstacked)
@@ -135,9 +143,12 @@ def block_forward(
     cache_index=None,
     sharder: Sharder = _identity_sharder,
     padding_mask: Optional[jnp.ndarray] = None,
-) -> Tuple[jnp.ndarray, Optional[Tuple[jnp.ndarray, jnp.ndarray]]]:
-    """One decoder layer. hidden_dropout_rate may be a traced scalar (LIMA
-    per-layer ramp, ref transformer.py:994-1001)."""
+) -> Tuple[jnp.ndarray, Optional[Tuple[jnp.ndarray, jnp.ndarray]], jnp.ndarray]:
+    """One decoder layer -> (y, kv_cache, moe_aux_loss).
+
+    hidden_dropout_rate may be a traced scalar (LIMA per-layer ramp, ref
+    transformer.py:994-1001). moe_aux_loss is a zero scalar for dense
+    models."""
     if dropout_key is not None:
         k_attn_drop, k_hidden1, k_hidden2 = jax.random.split(dropout_key, 3)
     else:
@@ -159,7 +170,7 @@ def block_forward(
         # Falcon: mlp input is ln1(x) (7B) or a dedicated ln_mlp(x) (40B);
         # one residual add for both branches.
         mlp_in = _norm(cfg, lp["ln_mlp"], x) if cfg.parallel_layernorm else normed
-        mlp_out = mlp_block(cfg, lp["mlp"], mlp_in)
+        mlp_out, moe_aux = _ffn(cfg, lp, mlp_in)
         mlp_out = _dropout(mlp_out, rate, k_hidden2 if cfg.hidden_dropout > 0 else None)
         res = normed if cfg.apply_residual_post_ln else x
         y = res + attn_out + mlp_out
@@ -170,11 +181,11 @@ def block_forward(
         y = res1 + attn_out
         y = sharder(y, "residual")
         normed2 = _norm(cfg, lp["ln2"], y)
-        mlp_out = mlp_block(cfg, lp["mlp"], normed2)
+        mlp_out, moe_aux = _ffn(cfg, lp, normed2)
         mlp_out = _dropout(mlp_out, rate, k_hidden2 if cfg.hidden_dropout > 0 else None)
         res2 = normed2 if cfg.apply_residual_post_ln else y
         y = res2 + mlp_out
         if cfg.use_post_ln:
             y = _norm(cfg, lp["ln1"], y)
     y = sharder(y, "residual")
-    return y, kv_cache
+    return y, kv_cache, moe_aux

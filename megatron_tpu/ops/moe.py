@@ -1,0 +1,117 @@
+"""Mixture-of-Experts layer: einsum-dispatched experts with top-k routing.
+
+Beyond the reference (epfLLM/Megatron-LLM has no MoE); the design follows
+the TPU lineage instead of torch gather/scatter MoE: GShard/Switch
+capacity-based dispatch expressed as dense einsums, so routing compiles to
+MXU-shaped matmuls with static shapes, and expert parallelism falls out of
+sharding the expert axis — no hand-written all-to-all (GSPMD inserts it
+when tokens are batch-sharded and experts are expert-sharded).
+
+Semantics:
+  * router: softmax over E experts in fp32, top-k selection per token
+    (k=1 Switch, k=2 GShard/Mixtral); optional renormalization of the
+    selected gate weights to sum 1 (Mixtral convention — with ample
+    capacity this makes the layer numerically equal to HF Mixtral's
+    dropless block).
+  * capacity: each expert processes at most C = ceil(capacity_factor *
+    top_k * tokens / E) tokens; overflow tokens lose that expert (their
+    other choices still apply; a token dropped by all choices passes
+    through with zero MLP output, the standard Switch behavior).
+  * auxiliary losses: Switch load-balance loss E * sum_e f_e * P_e over
+    the top-1 assignment fractions f and mean router probabilities P,
+    plus the router z-loss mean(logsumexp(logits)^2) (ST-MoE) for logit
+    drift control.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from megatron_tpu.config import ModelConfig
+from megatron_tpu.ops.activations import apply_activation
+
+
+def moe_capacity(cfg: ModelConfig, num_tokens: int) -> int:
+    """Static per-expert token capacity for a batch of num_tokens:
+    ceil(capacity_factor * top_k * tokens / E), floored at top_k."""
+    import math
+
+    E = cfg.num_experts
+    c = math.ceil(cfg.moe_capacity_factor * cfg.moe_top_k * num_tokens / E)
+    return max(cfg.moe_top_k, c)
+
+
+def topk_dispatch(
+    gates: jnp.ndarray,      # [N, E] fp32 router probabilities
+    top_k: int,
+    capacity: int,
+    renorm: bool,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Returns (combine [N,E,C] fp32, dispatch [N,E,C] bool, top1 [N,E]).
+
+    Slot assignment is by token order within each expert, k-level by
+    k-level (first choices claim slots before second choices), the GShard
+    priority rule.
+    """
+    N, E = gates.shape
+    topw, topi = jax.lax.top_k(gates, top_k)           # [N, k]
+    if renorm:
+        topw = topw / jnp.maximum(topw.sum(-1, keepdims=True), 1e-9)
+    combine = jnp.zeros((N, E, capacity), jnp.float32)
+    base = jnp.zeros((E,), jnp.int32)                  # slots already claimed
+    top1 = jax.nn.one_hot(topi[:, 0], E, dtype=jnp.float32)
+    for k in range(top_k):
+        m = jax.nn.one_hot(topi[:, k], E, dtype=jnp.int32)       # [N, E]
+        pos_in_e = jnp.cumsum(m, axis=0) - m + base[None, :]
+        pos = jnp.sum(pos_in_e * m, axis=1)                       # [N]
+        keep = (pos < capacity).astype(jnp.float32)
+        slot = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)   # [N, C]
+        w = topw[:, k] * keep
+        combine = combine + (w[:, None, None]
+                             * m.astype(jnp.float32)[:, :, None]
+                             * slot[:, None, :])
+        base = base + jnp.sum(m, axis=0)
+    return combine, combine > 0, top1
+
+
+def moe_block(
+    cfg: ModelConfig,
+    p: Dict[str, Any],   # one layer's moe subtree: router, w_in, w_out (+biases)
+    x: jnp.ndarray,      # [B, S, H]
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (y [B,S,H], aux_loss scalar fp32)."""
+    b, s, h = x.shape
+    N = b * s
+    xf = x.reshape(N, h)
+
+    logits = jnp.einsum("nh,he->ne", xf.astype(jnp.float32),
+                        p["router"].astype(jnp.float32))
+    gates = jax.nn.softmax(logits, axis=-1)
+
+    C = moe_capacity(cfg, N)
+    combine, dispatch, top1 = topk_dispatch(
+        gates, cfg.moe_top_k, C, cfg.moe_renorm_gates)
+
+    # load balance (Switch eq. 4) + router z-loss (ST-MoE)
+    E = cfg.num_experts
+    frac = jnp.mean(top1, axis=0)
+    prob = jnp.mean(gates, axis=0)
+    lb_loss = E * jnp.sum(frac * prob)
+    z_loss = jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2)
+    aux = (cfg.moe_aux_loss_coeff * lb_loss
+           + cfg.moe_z_loss_coeff * z_loss).astype(jnp.float32)
+
+    # dispatch -> per-expert batches -> combine, all as einsums
+    xe = jnp.einsum("nec,nh->ech", dispatch.astype(x.dtype), xf)
+    hmid = jnp.einsum("ech,ehf->ecf", xe, p["w_in"])
+    if "b_in" in p:
+        hmid = hmid + p["b_in"][:, None, :]
+    hmid = apply_activation(cfg.activation, hmid)
+    out = jnp.einsum("ecf,efh->ech", hmid, p["w_out"])
+    if "b_out" in p:
+        out = out + p["b_out"][:, None, :]
+    y = jnp.einsum("nec,ech->nh", combine.astype(x.dtype), out)
+    return y.reshape(b, s, h), aux

@@ -109,9 +109,9 @@ def _stage_fn(cfg: ModelConfig, chunk_layers: Any, x: jnp.ndarray,
         rate = rates_all[global_idx]
         key = (jax.random.fold_in(dropout_key, global_idx)
                if dropout_key is not None else None)
-        y, _ = block_forward(cfg, lp, x, rope, positions,
-                             dropout_key=key, hidden_dropout_rate=rate,
-                             **({"sharder": sharder} if sharder else {}))
+        y, _, _ = block_forward(cfg, lp, x, rope, positions,
+                                dropout_key=key, hidden_dropout_rate=rate,
+                                **({"sharder": sharder} if sharder else {}))
         return y, None
 
     policy = _remat_policy(recompute)
@@ -175,6 +175,11 @@ def make_pipeline_loss_fn(
     """
     Pn, M, V = num_stages, num_microbatches, num_virtual_chunks
     seg = remat_segment
+    if model_cfg.num_experts is not None:
+        raise NotImplementedError(
+            "MoE + pipeline parallelism is not wired yet (the router aux "
+            "loss needs accumulation across stages) — use dp/tp/ep for "
+            "MoE models")
     L = model_cfg.num_layers
     if L % (Pn * V):
         raise ValueError(

@@ -108,6 +108,44 @@ def test_gpt2_parity():
     _compare(model, cfg, "gpt2", vocab=96)
 
 
+def test_mixtral_parity_moe():
+    """Full-model logits parity for the MoE family (beyond the reference:
+    ample capacity + renormalized top-2 gates reproduce HF's dropless
+    Mixtral exactly)."""
+    from transformers import MixtralConfig
+    from transformers.models.mixtral.modeling_mixtral import (
+        MixtralForCausalLM,
+    )
+
+    hf_cfg = MixtralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5,
+        tie_word_embeddings=False, attn_implementation="eager",
+    )
+    model = MixtralForCausalLM(hf_cfg).eval()
+    cfg = config_from_hf(hf_cfg)
+    assert cfg.num_experts == 4 and cfg.moe_top_k == 2
+    cfg = cfg.__class__(**{**cfg.__dict__, "params_dtype": "float32"})
+    _compare(model, cfg, "mixtral")
+
+
+def test_roundtrip_mixtral():
+    from megatron_tpu.models import presets
+    from megatron_tpu.models.params import init_params
+
+    cfg = presets.tiny(vocab_size=128, num_experts=4, moe_top_k=2)
+    params = init_params(cfg, jax.random.PRNGKey(4))
+    sd = params_to_hf_state_dict(params, cfg, "mixtral")
+    back = hf_state_dict_to_params(sd, cfg, "mixtral", dtype=jnp.float32)
+    for (ka, a), (kb, b) in zip(
+        sorted(_leaves(params).items()), sorted(_leaves(back).items())
+    ):
+        assert ka == kb
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
 def test_roundtrip_llama():
     """native -> HF -> native is the identity (the reference tests the full
     convert/reshard/convert loop in test_llama_weights.py)."""

@@ -1,0 +1,223 @@
+"""Mixture-of-Experts layer: routing/capacity semantics, dense parity,
+HF Mixtral block parity, expert-parallel sharding, and training
+integration (beyond the reference — epfLLM/Megatron-LLM has no MoE)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatron_tpu.config import ParallelConfig
+from megatron_tpu.models import presets
+from megatron_tpu.models.language_model import lm_loss
+from megatron_tpu.models.params import init_params, param_specs
+from megatron_tpu.ops.moe import moe_block, moe_capacity, topk_dispatch
+
+
+def _moe_cfg(**kw):
+    base = dict(vocab_size=96, seq_length=16, hidden_size=32,
+                num_attention_heads=4, num_kv_heads=2, ffn_hidden_size=48,
+                num_experts=4, moe_top_k=2, moe_capacity_factor=2.0,
+                params_dtype="float32")
+    base.update(kw)
+    return presets.tiny(**base)
+
+
+def test_topk_dispatch_slots_and_weights():
+    gates = jnp.asarray([[0.7, 0.2, 0.1],
+                         [0.6, 0.3, 0.1],
+                         [0.1, 0.8, 0.1]], jnp.float32)
+    combine, dispatch, top1 = topk_dispatch(gates, top_k=1, capacity=2,
+                                            renorm=True)
+    # top-1 renormalized weight is 1.0; tokens 0,1 -> expert 0 slots 0,1
+    assert combine[0, 0, 0] == pytest.approx(1.0)
+    assert combine[1, 0, 1] == pytest.approx(1.0)
+    assert combine[2, 1, 0] == pytest.approx(1.0)
+    np.testing.assert_array_equal(np.asarray(top1).argmax(1), [0, 0, 1])
+    # each (expert, slot) holds at most one token
+    assert np.asarray(dispatch).sum(axis=0).max() <= 1
+
+
+def test_topk_dispatch_capacity_overflow_drops():
+    gates = jnp.asarray([[0.9, 0.1], [0.8, 0.2], [0.7, 0.3]], jnp.float32)
+    combine, dispatch, _ = topk_dispatch(gates, top_k=1, capacity=2,
+                                         renorm=False)
+    # third token overflows expert 0's capacity and is dropped entirely
+    assert np.asarray(dispatch)[2].sum() == 0
+    assert np.asarray(combine)[2].sum() == 0
+    # kept tokens carry the raw gate value when renorm is off
+    assert combine[0, 0, 0] == pytest.approx(0.9)
+
+
+def test_single_expert_matches_dense_mlp():
+    """E=1/top-1 with ample capacity is exactly the dense MLP."""
+    from megatron_tpu.models.transformer import mlp_block
+
+    cfg = _moe_cfg(num_experts=1, moe_top_k=1, moe_capacity_factor=4.0)
+    dense = _moe_cfg(num_experts=None)
+    rng = np.random.default_rng(0)
+    F_in = 2 * cfg.ffn_size  # swiglu gate+up
+    w_in = jnp.asarray(rng.normal(0, 0.02, (32, F_in)), jnp.float32)
+    w_out = jnp.asarray(rng.normal(0, 0.02, (cfg.ffn_size, 32)), jnp.float32)
+    x = jnp.asarray(rng.normal(0, 1, (2, 16, 32)), jnp.float32)
+    router = jnp.zeros((32, 1), jnp.float32)
+    y_moe, aux = moe_block(cfg, {"router": router, "w_in": w_in[None],
+                                 "w_out": w_out[None]}, x)
+    y_dense = mlp_block(dense, {"w_in": w_in, "w_out": w_out}, x)
+    np.testing.assert_allclose(np.asarray(y_moe), np.asarray(y_dense),
+                               rtol=1e-5, atol=1e-6)
+    # perfect balance (single expert): load-balance loss == coeff * 1.0
+    assert float(aux) == pytest.approx(cfg.moe_aux_loss_coeff, rel=1e-5)
+
+
+def test_moe_block_matches_hf_mixtral():
+    """Token-choice parity with HF's MixtralSparseMoeBlock (dropless): with
+    ample capacity and renormalized top-2 gates the layers are equal."""
+    torch = pytest.importorskip("torch")
+    from transformers.models.mixtral.configuration_mixtral import MixtralConfig
+    from transformers.models.mixtral.modeling_mixtral import (
+        MixtralSparseMoeBlock,
+    )
+
+    E, H, F, k = 4, 32, 48, 2
+    hf_cfg = MixtralConfig(hidden_size=H, intermediate_size=F,
+                           num_local_experts=E, num_experts_per_tok=k)
+    torch.manual_seed(0)
+    hf = MixtralSparseMoeBlock(hf_cfg).eval()
+
+    cfg = _moe_cfg(num_experts=E, moe_top_k=k, moe_capacity_factor=float(E),
+                   ffn_hidden_size=F)
+    router = jnp.asarray(hf.gate.weight.detach().numpy().T)  # [H, E]
+    w_in = jnp.stack([
+        jnp.concatenate([
+            jnp.asarray(ex.w1.weight.detach().numpy().T),   # gate
+            jnp.asarray(ex.w3.weight.detach().numpy().T),   # up
+        ], axis=-1) for ex in hf.experts])                   # [E, H, 2F]
+    w_out = jnp.stack([jnp.asarray(ex.w2.weight.detach().numpy().T)
+                       for ex in hf.experts])                # [E, F, H]
+
+    rng = np.random.default_rng(1)
+    x = np.asarray(rng.normal(0, 1, (2, 16, H)), np.float32)
+    y_ours, _ = moe_block(cfg, {"router": router, "w_in": w_in,
+                                "w_out": w_out}, jnp.asarray(x))
+    with torch.no_grad():
+        y_hf, _ = hf(torch.from_numpy(x))
+    np.testing.assert_allclose(np.asarray(y_ours), y_hf.numpy(),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_moe_lm_loss_and_grads_finite():
+    cfg = _moe_cfg()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    batch = {
+        "tokens": jnp.asarray(rng.integers(0, 96, (2, 16)), jnp.int32),
+        "labels": jnp.asarray(rng.integers(0, 96, (2, 16)), jnp.int32),
+        "loss_mask": jnp.ones((2, 16), jnp.float32),
+    }
+    loss, aux = lm_loss(cfg, params, batch)
+    assert np.isfinite(float(loss))
+    assert "moe_aux_loss" in aux and float(aux["moe_aux_loss"]) > 0
+    # total = CE + aux; metrics keep the pure CE term
+    assert float(loss) == pytest.approx(
+        float(aux["lm_loss"]) + float(aux["moe_aux_loss"]), rel=1e-6)
+    g = jax.grad(lambda p: lm_loss(cfg, p, batch)[0])(params)
+    for leaf in jax.tree.leaves(g):
+        assert np.isfinite(np.asarray(leaf)).all()
+    # router gets gradient signal (via gates and the aux loss)
+    assert float(jnp.abs(g["layers"]["moe"]["router"]).sum()) > 0
+
+
+def test_moe_expert_parallel_loss_parity():
+    """Experts sharded over the data axis (EP) x tensor: same loss as the
+    unsharded run."""
+    from megatron_tpu.parallel.mesh import build_mesh
+    from megatron_tpu.parallel.sharding import shard_tree
+
+    cfg = _moe_cfg()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    batch = {
+        "tokens": jnp.asarray(rng.integers(0, 96, (4, 16)), jnp.int32),
+        "labels": jnp.asarray(rng.integers(0, 96, (4, 16)), jnp.int32),
+        "loss_mask": jnp.ones((4, 16), jnp.float32),
+    }
+    ref = float(lm_loss(cfg, params, batch)[0])
+    rt = build_mesh(ParallelConfig(tensor_parallel=2))  # dp=4 x tp=2
+    sharded = shard_tree(rt, params, param_specs(cfg))
+    assert "moe" in param_specs(cfg)["layers"]
+    with jax.sharding.set_mesh(rt.mesh):
+        loss = float(jax.jit(lambda p, b: lm_loss(cfg, p, b)[0])(sharded,
+                                                                 batch))
+    assert loss == pytest.approx(ref, rel=1e-5)
+
+
+def test_moe_training_learns():
+    from megatron_tpu.config import OptimizerConfig, TrainingConfig
+    from megatron_tpu.training.optimizer import init_train_state
+    from megatron_tpu.training.train_step import make_train_step
+
+    cfg = _moe_cfg()
+    opt = OptimizerConfig(lr=5e-3, lr_decay_style="constant")
+    tcfg = TrainingConfig(micro_batch_size=4, global_batch_size=4, seed=0)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    state = init_train_state(opt, params)
+    step = jax.jit(make_train_step(cfg, opt, tcfg, num_microbatches=1,
+                                   train_iters=50))
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 96, (4, 17))
+    batch = {
+        "tokens": jnp.asarray(toks[:, :-1], jnp.int32),
+        "labels": jnp.asarray(toks[:, 1:], jnp.int32),
+        "loss_mask": jnp.ones((4, 16), jnp.float32),
+    }
+    losses = []
+    for _ in range(30):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] * 0.7
+
+
+def test_moe_pipeline_not_supported():
+    from megatron_tpu.parallel.mesh import build_mesh
+    from megatron_tpu.training.pipeline import make_pipeline_loss_fn
+
+    cfg = _moe_cfg()
+    rt = build_mesh(ParallelConfig(pipeline_parallel=2))
+    with pytest.raises(NotImplementedError, match="MoE"):
+        make_pipeline_loss_fn(cfg, rt.mesh, 2, 2)
+
+
+def test_moe_capacity_formula():
+    cfg = _moe_cfg(moe_capacity_factor=1.0)  # E=4, k=2
+    assert moe_capacity(cfg, 64) == 32       # 1.0 * 2 * 64 / 4
+    cfg = _moe_cfg(moe_capacity_factor=0.01)
+    assert moe_capacity(cfg, 64) == cfg.moe_top_k  # floor at top_k
+    cfg = _moe_cfg(num_experts=3, moe_top_k=1, moe_capacity_factor=1.0)
+    assert moe_capacity(cfg, 100) == 34      # ceil(33.3), not floor
+
+
+def test_moe_cli_knobs_override_preset():
+    from megatron_tpu.arguments import args_to_run_config, parse_args
+
+    base = ["--model_name", "mixtral", "--micro_batch_size", "1",
+            "--global_batch_size", "1"]
+    m = args_to_run_config(parse_args(base)).model
+    assert (m.num_experts, m.moe_top_k, m.rope_theta) == (8, 2, 1e6)
+    # explicit knobs override the preset even without --num_experts
+    m = args_to_run_config(parse_args(
+        base + ["--moe_aux_loss_coeff", "0.0", "--no_moe_renorm_gates"])).model
+    assert m.moe_aux_loss_coeff == 0.0 and m.moe_renorm_gates is False
+    assert m.num_experts == 8  # preset value untouched
+
+
+def test_moe_encoder_heads_rejected():
+    from megatron_tpu.models.bert import bert_config
+    from megatron_tpu.models.t5 import t5_config
+
+    with pytest.raises(NotImplementedError, match="MoE"):
+        bert_config(num_layers=2, hidden_size=32, num_attention_heads=4,
+                    vocab_size=96, seq_length=16, num_experts=4)
+    with pytest.raises(NotImplementedError, match="MoE"):
+        t5_config(num_layers=2, hidden_size=32, num_attention_heads=4,
+                  vocab_size=96, seq_length=16, num_experts=4)
