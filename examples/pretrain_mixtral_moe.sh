@@ -4,12 +4,12 @@
 # tensor-parallel inside each expert; top-2 renormalized routing with the
 # Switch load-balance loss.
 #
-# On a v5p-128 slice: dp16 x tp8, 8 experts -> each dp group holds one
-# expert shard half. Scale --num_experts/--moe_capacity_factor to taste.
+# On a v5p-128 slice: tp16 x dp8 — the 8 experts shard one-per-dp-rank
+# (num_experts must be divisible by the data-parallel degree).
 
 python pretrain_gpt.py \
     --model_name mixtral \
-    --tensor_model_parallel_size 8 \
+    --tensor_model_parallel_size 16 \
     --sequence_parallel \
     --use_distributed_optimizer \
     --num_experts 8 \

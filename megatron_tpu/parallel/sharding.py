@@ -102,6 +102,11 @@ def zero1_spec(spec: P, shape: tuple, dp: int) -> P:
     if dp <= 1:
         return spec
     entries = list(spec) + [None] * (len(shape) - len(spec))
+    if any(e == AXIS_DATA or (isinstance(e, tuple) and AXIS_DATA in e)
+           for e in entries):
+        # already data-sharded (expert-parallel MoE weights): the state is
+        # distributed over dp as-is; adding the axis again would be invalid
+        return spec
     for i, (axes, dim) in enumerate(zip(entries, shape)):
         if axes is None and dim % dp == 0:
             entries[i] = AXIS_DATA

@@ -124,6 +124,13 @@ class TrainLoop:
         self.timers = Timers(run_cfg.training.timing_log_level)
 
         model_cfg = run_cfg.model
+        E = model_cfg.num_experts
+        if E is not None and E % self.rt.dp:
+            raise ValueError(
+                f"num_experts={E} must be divisible by the data-parallel "
+                f"degree dp={self.rt.dp}: experts shard over the data axis "
+                f"(expert parallelism) — raise tensor/pipeline parallelism "
+                f"or change the expert count")
         self.specs = (param_specs_fn or param_specs)(model_cfg)
         params = (init_params_fn or init_params)(model_cfg, jax.random.fold_in(
             jax.random.PRNGKey(run_cfg.training.seed), 0))

@@ -178,6 +178,45 @@ def test_moe_training_learns():
     assert losses[-1] < losses[0] * 0.7
 
 
+def test_moe_zero1_state_specs_valid():
+    """ZeRO-1 must not re-add the data axis to EP-sharded expert params
+    (regression: DuplicateSpecError at optimizer-state sharding)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from megatron_tpu.parallel.mesh import build_mesh
+    from megatron_tpu.training.optimizer import (
+        init_train_state, train_state_specs,
+    )
+    from megatron_tpu.config import OptimizerConfig
+
+    cfg = _moe_cfg()
+    rt = build_mesh(ParallelConfig(tensor_parallel=2))  # dp=4
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    state = init_train_state(OptimizerConfig(lr=1e-3), params)
+    specs = train_state_specs(param_specs(cfg), params, rt.dp, zero1=True)
+    # constructing every NamedSharding raises on duplicate axes
+    shardings = jax.tree.map(lambda s: NamedSharding(rt.mesh, s), specs,
+                             is_leaf=lambda s: isinstance(s, P))
+    state = jax.device_put(state, shardings)
+    jax.block_until_ready(state.params)
+
+
+def test_moe_experts_must_divide_dp():
+    from megatron_tpu.training.pretrain import TrainLoop
+    from megatron_tpu.config import (
+        OptimizerConfig, RunConfig, TrainingConfig,
+    )
+
+    cfg = RunConfig(
+        model=_moe_cfg(num_experts=3, moe_top_k=2),
+        parallel=ParallelConfig(tensor_parallel=2),  # dp=4, 3 % 4 != 0
+        optimizer=OptimizerConfig(lr=1e-3),
+        training=TrainingConfig(micro_batch_size=1, global_batch_size=4,
+                                train_iters=1),
+    )
+    with pytest.raises(ValueError, match="divisible by the data-parallel"):
+        TrainLoop(cfg, log=lambda s: None)
+
+
 def test_moe_pipeline_not_supported():
     from megatron_tpu.parallel.mesh import build_mesh
     from megatron_tpu.training.pipeline import make_pipeline_loss_fn
@@ -209,6 +248,25 @@ def test_moe_cli_knobs_override_preset():
         base + ["--moe_aux_loss_coeff", "0.0", "--no_moe_renorm_gates"])).model
     assert m.moe_aux_loss_coeff == 0.0 and m.moe_renorm_gates is False
     assert m.num_experts == 8  # preset value untouched
+
+
+def test_moe_generation_matches_teacher_forcing():
+    """MoE decode through the KV-cache path: cached incremental greedy
+    generation matches argmax over full teacher-forced re-forwards."""
+    from megatron_tpu.inference.generation import generate_tokens
+    from megatron_tpu.models.language_model import lm_forward
+
+    cfg = _moe_cfg(seq_length=32)
+    params = init_params(cfg, jax.random.PRNGKey(2))
+    prompts = np.asarray([[5, 9, 11]], np.int32)
+    lengths = np.asarray([3], np.int32)
+    out = generate_tokens(cfg, params, prompts, lengths, max_new_tokens=5,
+                          temperature=0.0, vocab_size=96, eod=-1)
+    toks = np.asarray(out.tokens)[0]
+    for t in range(3, 8):
+        logits = lm_forward(cfg, params,
+                            jnp.asarray(toks[None, :t], jnp.int32))
+        assert int(np.argmax(np.asarray(logits)[0, -1])) == toks[t]
 
 
 def test_moe_encoder_heads_rejected():

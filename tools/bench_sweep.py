@@ -19,12 +19,21 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from bench import build_step, headline_config, is_oom, time_step
 
 
-def run_one(micro_bs, granularity, seq_length=2048, iters=5):
+def run_one(micro_bs, granularity, seq_length=2048, iters=5,
+            num_experts=None, moe_top_k=2):
     import jax
 
     from megatron_tpu.platform import peak_bf16_flops
 
     cfg = headline_config(seq_length=seq_length)
+    if num_experts:
+        # iso-parameter MoE variant of the headline geometry: E experts at
+        # ffn/E each, top-k routing (total expert params == dense mlp)
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            cfg, num_experts=num_experts, moe_top_k=moe_top_k,
+            ffn_hidden_size=cfg.ffn_size // num_experts).validate()
     state, step, batch = build_step(cfg, micro_bs, granularity)
     try:
         dt, _, state = time_step(state, step, batch, iters=iters)
@@ -36,10 +45,13 @@ def run_one(micro_bs, granularity, seq_length=2048, iters=5):
     tokens_per_sec = micro_bs * seq_length / dt
     achieved = tokens_per_sec * 3.0 * cfg.flops_per_token_fwd()
     peak = peak_bf16_flops(jax.devices()[0])
-    return {"micro_bs": micro_bs, "recompute": granularity, "oom": False,
-            "step_ms": round(dt * 1e3, 2),
-            "tokens_per_sec": round(tokens_per_sec),
-            "mfu": round(achieved / peak, 4)}
+    out = {"micro_bs": micro_bs, "recompute": granularity, "oom": False,
+           "step_ms": round(dt * 1e3, 2),
+           "tokens_per_sec": round(tokens_per_sec),
+           "mfu": round(achieved / peak, 4)}
+    if num_experts:
+        out["experts"] = f"{num_experts}top{moe_top_k}"
+    return out
 
 
 def main():
@@ -47,10 +59,14 @@ def main():
     ap.add_argument("--micro_bs", nargs="+", type=int, default=[4, 8])
     ap.add_argument("--recompute", nargs="+", default=["selective"])
     ap.add_argument("--seq_length", type=int, default=2048)
+    ap.add_argument("--experts", type=int, default=None,
+                    help="bench the iso-param MoE variant with N experts")
+    ap.add_argument("--topk", type=int, default=2)
     args = ap.parse_args()
     for g in args.recompute:
         for mbs in sorted(args.micro_bs):
-            out = run_one(mbs, g, args.seq_length)
+            out = run_one(mbs, g, args.seq_length,
+                          num_experts=args.experts, moe_top_k=args.topk)
             print(json.dumps(out), flush=True)
             if out.get("oom"):
                 break  # ascending order: every larger mbs will OOM too
