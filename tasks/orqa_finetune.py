@@ -1,0 +1,274 @@
+"""ORQA supervised retriever finetuning on DPR-format Natural Questions.
+
+Equivalent of tasks/orqa/supervised/{data.py,finetune.py,eval_utils.py}
+(722 LoC): the biencoder's query/context towers are finetuned with a
+softmax retrieval loss whose candidate set is the in-batch positive
+contexts plus (--train_with_neg) each sample's hard negatives, labels on
+the diagonal (finetune.py cross_entropy_loss_func:146-155). Evaluation
+reports mean rank and top-k accuracies over positives + per-sample
+negatives (eval_utils.retrieval_loss:125-192).
+
+TPU-first differences: the reference all-gathers context/query embeddings
+across the DP group with an autograd-preserving gather
+(finetune.py:104-135); here the loss is jitted over the whole global batch
+and GSPMD inserts the gather — the candidate set is identical. Variable
+negative counts are padded to a static [B, N, S] block (all-pad rows act
+as easy negatives) so shapes stay XLA-static.
+
+Data format (DPR codebase): JSON list of rows with `question`, `answers`,
+`positive_ctxs`, `hard_negative_ctxs`, `negative_ctxs`; each ctx has
+`title` and `text` (data.py NQSupervisedDataset:236-287).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def normalize_question(q: str) -> str:
+    # ref data.py:229-232
+    return q[:-1] if q.endswith("?") else q
+
+
+def load_dpr_json(path: str) -> List[Dict[str, Any]]:
+    """DPR retriever JSON -> samples; rows without a positive are dropped
+    (the reference indexes positive_ctxs[0] unconditionally and would
+    crash — real DPR NQ files always have one)."""
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    samples = []
+    for row in data:
+        if not row.get("positive_ctxs"):
+            continue
+        samples.append({
+            "question": normalize_question(row["question"]),
+            "pos_context": row["positive_ctxs"][0],
+            "hard_negative_context": row.get("hard_negative_ctxs") or [],
+            "negative_context": row.get("negative_ctxs") or [],
+            "answers": row.get("answers") or [],
+        })
+    return samples
+
+
+def _encode(ids: Sequence[int], seq_len: int, cls_id: int, sep_id: int,
+            pad_id: int) -> Tuple[np.ndarray, np.ndarray]:
+    """[CLS] ids [SEP] pad -> (tokens[S] int64, pad_mask[S] int64);
+    ref data.py build_tokens_types_paddings_from_ids:58-95."""
+    enc = [cls_id] + list(ids)
+    enc = enc[: seq_len - 1] + [sep_id]
+    n = len(enc)
+    toks = np.full((seq_len,), pad_id, np.int64)
+    toks[:n] = enc
+    mask = np.zeros((seq_len,), np.int64)
+    mask[:n] = 1
+    return toks, mask
+
+
+class NQSupervisedDataset:
+    """Tokenized DPR samples with a STATIC number of negatives per item.
+
+    train mode (evaluate=False): `num_neg` hard negatives, topped up with
+    simple negatives then all-pad rows; shuffled per (seed, epoch-free
+    idx) so runs are deterministic (ref data.py:188-207 shuffles with the
+    global RNG instead).
+    eval mode: first `val_other_neg` simple + `val_hard_neg` hard
+    negatives, unshuffled (ref data.py:181-187).
+    """
+
+    def __init__(self, samples: List[Dict], tokenize: Callable[[str], List[int]],
+                 seq_len: int, cls_id: int = 101, sep_id: int = 102,
+                 pad_id: int = 0, evaluate: bool = False, num_neg: int = 0,
+                 val_hard_neg: int = 30, val_other_neg: int = 30,
+                 seed: int = 1234):
+        self.samples = samples
+        self.tokenize = tokenize
+        self.seq_len = seq_len
+        self.ids = (cls_id, sep_id, pad_id)
+        self.evaluate = evaluate
+        self.num_neg = (val_hard_neg + val_other_neg) if evaluate else num_neg
+        self.val_hard_neg, self.val_other_neg = val_hard_neg, val_other_neg
+        self.seed = seed
+
+    def __len__(self) -> int:
+        return len(self.samples)
+
+    def _ctx_ids(self, ctx: Dict[str, str]) -> List[int]:
+        # title [SEP] text — ref data.py:42-47
+        return (self.tokenize(ctx.get("title") or "") + [self.ids[1]]
+                + self.tokenize(ctx.get("text") or ""))
+
+    def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
+        cls_id, sep_id, pad_id = self.ids
+        s = self.samples[idx]
+        qt, qm = _encode(self.tokenize(s["question"]), self.seq_len,
+                         cls_id, sep_id, pad_id)
+        ct, cm = _encode(self._ctx_ids(s["pos_context"]), self.seq_len,
+                         cls_id, sep_id, pad_id)
+        item = {"query_tokens": qt, "query_pad_mask": qm,
+                "context_tokens": ct, "context_pad_mask": cm}
+        if self.num_neg > 0:
+            if self.evaluate:
+                negs = (s["negative_context"][: self.val_other_neg]
+                        + s["hard_negative_context"][: self.val_hard_neg])
+            else:
+                rng = np.random.RandomState((self.seed + idx) & 0x7FFFFFFF)
+                hard = list(s["hard_negative_context"])
+                simple = list(s["negative_context"])
+                rng.shuffle(hard)
+                rng.shuffle(simple)
+                # hard first, topped up with simple (ref data.py:196-203)
+                negs = (hard + simple)[: self.num_neg]
+            nt = np.full((self.num_neg, self.seq_len), pad_id, np.int64)
+            nm = np.zeros((self.num_neg, self.seq_len), np.int64)
+            for i, ctx in enumerate(negs[: self.num_neg]):
+                nt[i], nm[i] = _encode(self._ctx_ids(ctx), self.seq_len,
+                                       cls_id, sep_id, pad_id)
+            item["neg_context_tokens"] = nt
+            item["neg_context_pad_mask"] = nm
+        return item
+
+
+def _embed_candidates(cfg, params, batch, dropout_key=None):
+    """(q [B,D], c [B(1+N),D]) — positives first, then flattened negatives,
+    matching the reference's torch.cat([context, neg_context]) order
+    (finetune.py:86-89) so labels are arange(B)."""
+    import jax
+    import jax.numpy as jnp
+
+    from megatron_tpu.models.biencoder import embed_text
+
+    qt = params.get("shared", params.get("query"))
+    ct = params.get("shared", params.get("context"))
+    kq = kc = kn = None
+    if dropout_key is not None:
+        kq, kc, kn = jax.random.split(dropout_key, 3)
+    q = embed_text(cfg, qt, batch["query_tokens"],
+                   batch["query_pad_mask"] > 0, kq)
+    c = embed_text(cfg, ct, batch["context_tokens"],
+                   batch["context_pad_mask"] > 0, kc)
+    if "neg_context_tokens" in batch:
+        nt = batch["neg_context_tokens"]
+        B, N, S = nt.shape
+        n = embed_text(cfg, ct, nt.reshape(B * N, S),
+                       batch["neg_context_pad_mask"].reshape(B * N, S) > 0, kn)
+        c = jnp.concatenate([c, n], axis=0)
+    return q, c
+
+
+def orqa_loss(cfg, params, batch, dropout_key=None, score_scaling: bool = False,
+              topk: Tuple[int, ...] = (1, 5, 20), sharder=None):
+    """Softmax retrieval loss over in-batch positives + negatives
+    (ref finetune.py cross_entropy_loss_func:120-174)."""
+    import jax.numpy as jnp
+
+    from megatron_tpu.ops.cross_entropy import cross_entropy_loss
+
+    q, c = _embed_candidates(cfg, params, batch, dropout_key)
+    scores = jnp.einsum("qd,cd->qc", q.astype(jnp.float32),
+                        c.astype(jnp.float32))
+    if score_scaling:
+        scores = scores / jnp.sqrt(jnp.asarray(cfg.hidden_size, jnp.float32))
+    B = q.shape[0]
+    labels = jnp.arange(B)
+    loss, _ = cross_entropy_loss(scores[:, None, :], labels[:, None])
+    ranks = jnp.sum(
+        scores > jnp.take_along_axis(scores, labels[:, None], axis=1), axis=1)
+    aux = {"loss": loss,
+           "correct": jnp.mean((ranks == 0).astype(jnp.float32))}
+    for k in topk:
+        if k <= scores.shape[1]:
+            aux[f"top{k}_acc"] = jnp.mean((ranks < k).astype(jnp.float32))
+    return loss, aux
+
+
+def orqa_eval(loop, valid_ds, batch: int = 8, score_scaling: bool = False,
+              topk: Sequence[int] = (1, 5, 20)) -> Dict[str, float]:
+    """Mean rank + top-k accuracies over the eval set, candidate set =
+    batch positives + batch negatives (ref eval_utils.retrieval_loss)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tasks.finetune_utils import _collate
+
+    model_cfg = loop.cfg.model
+
+    @jax.jit
+    def rank_vec(p, b, col_real):
+        q, c = _embed_candidates(model_cfg, p, b)
+        scores = jnp.einsum("qd,cd->qc", q.astype(jnp.float32),
+                            c.astype(jnp.float32))
+        if score_scaling:
+            scores = scores / jnp.sqrt(
+                jnp.asarray(model_cfg.hidden_size, jnp.float32))
+        # tail batches are padded with copies of row 0; their positive and
+        # negative candidates must not enter any real query's candidate set
+        scores = jnp.where(col_real[None, :], scores, -jnp.inf)
+        labels = jnp.arange(q.shape[0])
+        return jnp.sum(scores > jnp.take_along_axis(
+            scores, labels[:, None], axis=1), axis=1)
+
+    n_neg = getattr(valid_ds, "num_neg", 0)
+    ranks: List[int] = []
+    with jax.sharding.set_mesh(loop.rt.mesh):
+        for i in range(0, len(valid_ds), batch):
+            rows = [valid_ds[j] for j in range(i, min(i + batch, len(valid_ds)))]
+            n_real = len(rows)
+            rows += [rows[0]] * (batch - n_real)
+            row_real = np.arange(batch) < n_real
+            col_real = (np.concatenate([row_real, np.repeat(row_real, n_neg)])
+                        if n_neg else row_real)
+            vec = np.asarray(rank_vec(loop.state.params,
+                                      loop._put_batch(_collate(rows)),
+                                      jnp.asarray(col_real)))
+            ranks.extend(int(r) for r in vec[:n_real])
+    arr = np.asarray(ranks, np.float64)
+    out = {"rank": float(arr.mean() + 1.0)}  # ref reports 1-based mean rank
+    for k in topk:
+        out[f"top{k}_acc"] = float((arr < k).mean())
+    return out
+
+
+def finetune_orqa(cfg, train_ds, valid_ds, *, ict_head_size: int = 128,
+                  shared: bool = False, score_scaling: bool = False,
+                  topk: Sequence[int] = (1, 5, 20),
+                  log: Callable[[str], None] = print):
+    """Train the biencoder on the retrieval objective; returns (loop,
+    final eval stats). cfg.training.train_iters must be set."""
+    import functools
+
+    from megatron_tpu.models.biencoder import (
+        biencoder_init_params, biencoder_param_specs,
+    )
+    from megatron_tpu.training.pretrain import TrainLoop
+    from tasks.finetune_utils import _epoch_iter
+
+    def loss_fn(model_cfg, p, b, key, sharder=None):
+        return orqa_loss(model_cfg, p, b, dropout_key=key,
+                         score_scaling=score_scaling, topk=tuple(topk))
+
+    # fixed_num_microbatches=1: the in-batch softmax needs the whole global
+    # batch as candidates (see pretrain_ict.py:105-109).
+    loop = TrainLoop(
+        cfg, log=log,
+        init_params_fn=functools.partial(biencoder_init_params,
+                                         ict_head_size=ict_head_size,
+                                         shared=shared),
+        param_specs_fn=functools.partial(biencoder_param_specs, shared=shared),
+        loss_fn=loss_fn,
+        fixed_num_microbatches=1)
+
+    seed = cfg.training.seed
+
+    def train_iter_factory(consumed, gbs):
+        return _epoch_iter(train_ds, consumed, gbs, seed)
+
+    loop.train(train_iter_factory)
+    # eval with the training global batch so the candidate-set size matches
+    # the training objective (ref eval uses eval_micro_batch_size)
+    stats = orqa_eval(loop, valid_ds, batch=cfg.training.global_batch_size,
+                      score_scaling=score_scaling, topk=topk)
+    log(" | ".join(f"{k} = {v:.4f}" for k, v in stats.items()))
+    return loop, stats

@@ -1,0 +1,160 @@
+"""MSDP preprocessing pipeline (counterpart: reference
+tasks/msdp/preprocessing.py — untested upstream)."""
+
+import json
+
+import numpy as np
+
+from tasks.msdp import read_knowledge_prompts, word_tokenize
+from tasks.msdp_preprocess import (
+    get_database, hash_embed, prepare_input_for_response_generation,
+    process_woi_dataset, process_wow_dataset,
+    prompt_selection_for_knowledge_generation,
+    prompt_selection_for_response_generation,
+)
+
+
+def _wow_json(path):
+    data = [{
+        "chosen_topic": "Jazz",
+        "dialog": [
+            {"speaker": "0_Apprentice", "text": "I love jazz"},
+            {"speaker": "1_Wizard", "text": "Jazz began in New Orleans",
+             "checked_sentence": {"s": "Jazz originated in New Orleans."},
+             "checked_passage": {"p": "Jazz"}},
+            {"speaker": "0_Apprentice", "text": "Tell me more!"},
+            {"speaker": "1_Wizard", "text": "It grew from blues.",
+             "checked_sentence": {}, "checked_passage": {}},
+        ],
+    }]
+    path.write_text(json.dumps(data))
+
+
+def test_process_wow_dataset(tmp_path):
+    raw = tmp_path / "wow.json"
+    _wow_json(raw)
+    proc, knwl, resp = (tmp_path / n for n in ("t.tsv", "k.txt", "r.txt"))
+    n = process_wow_dataset(str(raw), str(proc), str(knwl), str(resp))
+    assert n == 2
+    rows = [l.split("\t") for l in proc.read_text().splitlines()]
+    assert rows[0][0] == "Jazz"
+    assert rows[0][1] == "I love jazz."          # context: punct normalized
+    assert rows[0][2] == "Jazz originated in New Orleans."
+    assert rows[0][3] == "Jazz began in New Orleans."
+    # second wizard turn: no checked sentence -> no_passages_used, topic
+    # falls back to chosen_topic; context includes prior wizard response
+    assert rows[1][2] == "no_passages_used"
+    assert "Jazz began in New Orleans." in rows[1][1]
+    assert len(knwl.read_text().splitlines()) == 2
+    # responses are tokenized for F1 eval
+    assert resp.read_text().splitlines()[1] == "It grew from blues ."
+
+
+def test_process_woi_dataset(tmp_path):
+    raw = tmp_path / "woi.jsonl"
+    rec = {"d1": {"dialog_history": [
+        {"action": "Wizard => Apprentice", "text": "opening turn"},
+        {"action": "Wizard => SearchAgent", "text": "Mount Fuji"},
+        {"action": "SearchAgent => Wizard", "text": "results"},
+        {"action": "Apprentice => Wizard", "text": "tell me about fuji"},
+        {"action": "Wizard => Apprentice", "text": "Fuji is 3776m tall",
+         "context": {"contents": [{"content": ["Mount Fuji is 3776 m.",
+                                               "It is in Japan."]}],
+                     "selected_contents": [[False], [False, True]]}},
+    ]}}
+    raw.write_text(json.dumps(rec) + "\n")
+    proc = tmp_path / "t.tsv"
+    n = process_woi_dataset(str(raw), str(proc))
+    assert n == 1
+    row = proc.read_text().splitlines()[0].split("\t")
+    assert row[0] == "Mount Fuji"
+    assert row[2] == "It is in Japan."
+    assert row[3] == "Fuji is 3776m tall"
+    assert "opening turn" in row[1] and "tell me about fuji" in row[1]
+
+
+def _tsv_line(topic, turns, knowledge, response):
+    return topic + "\t" + " [SEP] ".join(turns) + "\t" + knowledge + "\t" \
+        + response + "\n"
+
+
+def test_get_database_filters(tmp_path):
+    test_f = tmp_path / "test.tsv"
+    train_f = tmp_path / "train.tsv"
+    test_f.write_text(_tsv_line("Jazz", ["a"], "k", "r"))
+    train_f.write_text(
+        _tsv_line("Jazz", ["t1", "t2"], "Jazz is music", "resp one")
+        + _tsv_line("Rock", ["t3"], "Rock has (brackets)", "resp two")
+        + _tsv_line("Pop", ["t4"], "no_passages_used", "resp three")
+        + _tsv_line("Folk", ["t5"], "Folk " + "w " * 25, "resp four"))
+    by_topic, dialogs, examples = get_database(str(test_f), str(train_f),
+                                               "wow_unseen")
+    # Jazz: test-topic -> kept in by_topic; Rock: brackets dropped;
+    # Pop: no knowledge dropped; Folk: >20 tokens dropped from examples
+    assert list(by_topic) == ["Jazz"]
+    assert len(by_topic["Jazz"]) == len(dialogs["Jazz"]) == 1
+    assert by_topic["Jazz"][0] == "( t2 ) Jazz => Jazz is music"
+    assert [t for t, _, _ in examples] == ["Jazz"]
+    # wow_seen keeps bracketed/topic-mismatched knowledge
+    _, _, seen_examples = get_database(str(test_f), str(train_f), "wow_seen")
+    assert len(seen_examples) == 2
+
+
+def test_knowledge_prompt_selection_both_branches(tmp_path):
+    test_f = tmp_path / "test.tsv"
+    train_f = tmp_path / "train.tsv"
+    test_f.write_text(
+        _tsv_line("Jazz", ["last jazz turn"], "k", "r")       # seen topic
+        + _tsv_line("Opera", ["an opera question"], "k", "r"))  # unseen
+    train_f.write_text(
+        _tsv_line("Jazz", ["jazz history talk"], "Jazz is music", "r1")
+        + _tsv_line("Jazz", ["jazz masters"], "Jazz has swing", "r2")
+        + _tsv_line("Blues", ["blues roots"], "Blues is Blues music", "r3"))
+    out = tmp_path / "prompts.jsonl"
+    n = prompt_selection_for_knowledge_generation(
+        str(test_f), str(train_f), str(out), "wow_unseen")
+    assert n == 2
+    prompts = read_knowledge_prompts(str(out))  # consumable by tasks.msdp
+    jazz = prompts["Jazz last jazz turn"]  # examples joined into one prompt
+    assert jazz.count("Jazz =>") == 2
+    opera = prompts["Opera an opera question"]
+    assert 1 <= opera.count("=>") <= 10  # one instance per distinct topic
+
+
+def test_response_prompt_selection_overlap_filter(tmp_path):
+    kn = " ".join(f"w{i}" for i in range(12))
+    good = _tsv_line("T", ["turn"], kn, kn)  # 100%? no: overlap==resp len
+    # response = knowledge + 4 extra tokens -> overlap 12/16 = 75% of resp,
+    # 100% of knowledge -> kept
+    resp = kn + " x y z q"
+    rows = (_tsv_line("T", ["turn"], kn, resp)
+            + _tsv_line("U", ["turn"], kn, "short reply")       # no overlap
+            + _tsv_line("V", ["turn"], "no_passages_used", kn))  # no knwl
+    f = tmp_path / "train.tsv"
+    f.write_text(rows)
+    out = tmp_path / "prompt.txt"
+    n = prompt_selection_for_response_generation(str(f), str(out), seed=1)
+    assert n == 1
+    line = out.read_text().splitlines()[0]
+    assert line.startswith("Topic: T. User says: turn We know that: w0")
+    assert "System replies: w0" in line
+
+
+def test_prepare_input_substitutes_generated_knowledge(tmp_path):
+    test_f = tmp_path / "test.tsv"
+    test_f.write_text(_tsv_line("T", ["c"], "gold knowledge", "resp"))
+    gen = tmp_path / "gen.txt"
+    gen.write_text("generated knowledge<|endoftext|>\n")
+    out = tmp_path / "out.tsv"
+    n = prepare_input_for_response_generation(str(test_f), str(gen), str(out))
+    assert n == 1
+    row = out.read_text().splitlines()[0].split("\t")
+    assert row[2] == "generated knowledge"
+    assert row[3] == "resp"
+
+
+def test_hash_embed_properties():
+    e = hash_embed(["jazz music swing", "jazz music swing", "opera aria"])
+    np.testing.assert_allclose(e[0], e[1])
+    assert float(e[0] @ e[0]) > float(e[0] @ e[2])
+    assert np.allclose(np.linalg.norm(e, axis=1), 1.0, atol=1e-5)
