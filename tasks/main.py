@@ -51,6 +51,30 @@ def extra_args(p):
     return p
 
 
+def _build_task_tokenizer(args, vocab_size):
+    from megatron_tpu.tokenizer.tokenizer import build_tokenizer
+
+    return build_tokenizer(args.tokenizer_type, vocab_size=vocab_size,
+                           tokenizer_model=getattr(args, "tokenizer_model",
+                                                   None),
+                           vocab_extra_ids=args.vocab_extra_ids or 0,
+                           new_tokens=args.new_tokens)
+
+
+def _finetune_cfg(args, cfg, n_train):
+    """train_iters from epochs + pretrained-checkpoint load/finetune flags
+    — shared by every finetune task."""
+    import dataclasses
+
+    t = cfg.training
+    iters = max(1, args.epochs * n_train // t.global_batch_size)
+    training = dataclasses.replace(
+        t, train_iters=iters,
+        load=args.pretrained_checkpoint or t.load,
+        finetune=bool(args.pretrained_checkpoint) or t.finetune)
+    return dataclasses.replace(cfg, training=training), iters
+
+
 def run_orqa(args, cfg):
     """RET-FINETUNE-NQ: supervised DPR-style retriever finetuning."""
     import dataclasses
@@ -58,7 +82,6 @@ def run_orqa(args, cfg):
     import numpy as np
 
     from megatron_tpu.models.biencoder import biencoder_config
-    from megatron_tpu.tokenizer.tokenizer import build_tokenizer
     from tasks.orqa_finetune import (
         NQSupervisedDataset, finetune_orqa, load_dpr_json,
     )
@@ -75,10 +98,7 @@ def run_orqa(args, cfg):
     )
     cfg = dataclasses.replace(cfg, model=model)
 
-    tok = build_tokenizer(args.tokenizer_type, vocab_size=model.vocab_size,
-                          tokenizer_model=getattr(args, "tokenizer_model", None),
-                          vocab_extra_ids=args.vocab_extra_ids or 0,
-                          new_tokens=args.new_tokens)
+    tok = _build_task_tokenizer(args, model.vocab_size)
     ids = dict(cls_id=args.cls_token_id, sep_id=args.sep_token_id,
                pad_id=args.pad_token_id, seed=cfg.training.seed)
     train_raw = [s for p in args.train_data for s in load_dpr_json(p)]
@@ -97,13 +117,7 @@ def run_orqa(args, cfg):
                                    val_other_neg=args.val_av_rank_other_neg,
                                    **ids)
 
-    t = cfg.training
-    iters = max(1, args.epochs * len(train_ds) // t.global_batch_size)
-    training = dataclasses.replace(
-        t, train_iters=iters,
-        load=args.pretrained_checkpoint or t.load,
-        finetune=bool(args.pretrained_checkpoint) or t.finetune)
-    cfg = dataclasses.replace(cfg, training=training)
+    cfg, iters = _finetune_cfg(args, cfg, len(train_ds))
     print(f"RET-FINETUNE-NQ: {len(train_ds)} train / {len(valid_ds)} valid, "
           f"{num_neg} hard negatives/sample, {iters} iterations")
     finetune_orqa(cfg, train_ds, valid_ds,
@@ -117,7 +131,6 @@ def main(argv=None):
     import dataclasses
 
     from megatron_tpu.models.classification import classification_config
-    from megatron_tpu.tokenizer.tokenizer import build_tokenizer
     from tasks.finetune_utils import finetune_classification
     from tasks.glue import GlueDataset, load_mnli, load_qqp
     from tasks.race import RaceDataset, load_race
@@ -136,10 +149,7 @@ def main(argv=None):
     )
     cfg = dataclasses.replace(cfg, model=model)
 
-    tok = build_tokenizer(args.tokenizer_type, vocab_size=cfg.model.vocab_size,
-                          tokenizer_model=getattr(args, "tokenizer_model", None),
-                          vocab_extra_ids=args.vocab_extra_ids or 0,
-                          new_tokens=args.new_tokens)
+    tok = _build_task_tokenizer(args, cfg.model.vocab_size)
     ids = dict(cls_id=args.cls_token_id, sep_id=args.sep_token_id,
                pad_id=args.pad_token_id)
 
@@ -157,13 +167,7 @@ def main(argv=None):
         train_ds = GlueDataset(train_raw, tok.tokenize, cfg.model.seq_length, **ids)
         valid_ds = GlueDataset(valid_raw, tok.tokenize, cfg.model.seq_length, **ids)
 
-    t = cfg.training
-    iters = max(1, args.epochs * len(train_ds) // t.global_batch_size)
-    training = dataclasses.replace(
-        t, train_iters=iters,
-        load=args.pretrained_checkpoint or t.load,
-        finetune=bool(args.pretrained_checkpoint) or t.finetune)
-    cfg = dataclasses.replace(cfg, training=training)
+    cfg, iters = _finetune_cfg(args, cfg, len(train_ds))
 
     print(f"{args.task}: {len(train_ds)} train / {len(valid_ds)} valid "
           f"samples, {num_classes} classes, {iters} iterations")

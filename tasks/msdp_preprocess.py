@@ -246,26 +246,38 @@ def prompt_selection_for_knowledge_generation(
     train_by_topic, dialog_by_topic, dialog_examples = get_database(
         test_datapath, train_datapath, data_type)
 
-    all_dialog_embs = (embed_fn([d for _, d, _ in dialog_examples])
-                       if dialog_examples else None)
+    # corpus embeddings are only needed for unseen-topic queries; compute
+    # them lazily so an all-seen test set never pays the full-corpus embed
+    _corpus_embs: List[np.ndarray] = []
+
+    def corpus_embs() -> np.ndarray:
+        if not _corpus_embs:
+            _corpus_embs.append(embed_fn([d for _, d, _ in dialog_examples]))
+        return _corpus_embs[0]
+
     topic_embs: Dict[str, np.ndarray] = {}
 
-    written = 0
-    with open(test_datapath, encoding="utf-8") as f, \
-            open(output_prompt_path, "w", encoding="utf-8") as out:
+    # one batched embed_fn call for every test query (a model-backed
+    # embed_fn pays per invocation, not per string)
+    rows: List[Tuple[str, List[str]]] = []
+    with open(test_datapath, encoding="utf-8") as f:
         for line in f:
             line = line.strip()
             if not line:
                 continue
             splits = line.split("\t")
-            topic, turns = splits[0], splits[1].split(" [SEP] ")[-3:]
-            # the reference checks `data_type != "seen"` here (:405) but
-            # builds the database with `!= "wow_seen"` (:285); we use the
-            # database convention on both sides so query and example
-            # embeddings live in the same text space
-            query = ("( " + topic + " ) " if data_type != "wow_seen" else "") \
-                + " ".join(turns)
-            q = embed_fn([query])[0]
+            rows.append((splits[0], splits[1].split(" [SEP] ")[-3:]))
+    # the reference checks `data_type != "seen"` when building the query
+    # (:405) but builds the database with `!= "wow_seen"` (:285); we use
+    # the database convention on both sides so query and example
+    # embeddings live in the same text space
+    queries = [("( " + topic + " ) " if data_type != "wow_seen" else "")
+               + " ".join(turns) for topic, turns in rows]
+    query_embs = embed_fn(queries) if queries else np.zeros((0, 1))
+
+    written = 0
+    with open(output_prompt_path, "w", encoding="utf-8") as out:
+        for (topic, turns), q in zip(rows, query_embs):
             if topic not in train_by_topic:
                 if not dialog_examples:
                     out.write(json.dumps({topic + " " + turns[-1]: []}) + "\n")
@@ -273,7 +285,7 @@ def prompt_selection_for_knowledge_generation(
                     continue
                 # nearest dialogs across the corpus, one per topic,
                 # least-similar-first (ref :389-421 reverses at the end)
-                sims = all_dialog_embs @ q
+                sims = corpus_embs() @ q
                 seen_topics = set()
                 selected: List[str] = []
                 for idx in np.argsort(-sims):

@@ -23,7 +23,7 @@ from megatron_tpu.platform import ensure_platform
 
 ensure_platform()
 
-from typing import Callable, List, Sequence
+from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
@@ -39,7 +39,7 @@ def _contains(haystack: np.ndarray, needle: Sequence[int]) -> bool:
 
 def evaluate_retriever(
     questions: List[str],
-    answers: List[str],
+    answers: List,                # str or List[str] per question
     tokenize: Callable[[str], List[int]],
     query_embed: Callable[[np.ndarray, np.ndarray], np.ndarray],
     index: np.ndarray,           # [N, D]
@@ -50,8 +50,16 @@ def evaluate_retriever(
     pad_id: int,
     topk: Sequence[int] = (1, 5, 20),
     batch_size: int = 32,
+    match: str = "token",
+    detokenize: Optional[Callable[[Sequence[int]], str]] = None,
 ):
-    """Returns {f"top{k}": hit_rate}."""
+    """Returns {f"top{k}": hit_rate}.
+
+    match="token": answer token sequence must appear in the block's tokens
+    (this stack's native criterion — no detokenizer required).
+    match="string"/"regex": DPR's text-level criteria
+    (tasks/qa_utils.has_answer, ref qa_utils.py:112-140) over the
+    detokenized block; requires `detokenize`."""
     from tools.build_retrieval_index import search
 
     if not questions:
@@ -59,6 +67,8 @@ def evaluate_retriever(
                          "lines)")
     if not topk:
         raise SystemExit("--topk needs at least one value")
+    if match != "token" and detokenize is None:
+        raise SystemExit(f"--match {match} needs a detokenizing tokenizer")
     toks = np.full((len(questions), max_query_len), pad_id, np.int64)
     mask = np.zeros((len(questions), max_query_len), np.float32)
     for i, q in enumerate(questions):
@@ -82,10 +92,21 @@ def evaluate_retriever(
     _, ids = search(index, q_emb, topk=kmax)
     hits = np.zeros((n, kmax), bool)
     for qi in range(n):
-        ans = tokenize(answers[qi])
+        ans_list = (answers[qi] if isinstance(answers[qi], (list, tuple))
+                    else [answers[qi]])
+        if match == "token":
+            toks = [tokenize(a) for a in ans_list]
+            found = lambda block: any(
+                _contains(block, t) for t in toks if t)
+            get = lambda bid: np.asarray(get_block_tokens(bid), np.int64)
+        else:
+            from tasks.qa_utils import has_answer
+
+            found = lambda text: has_answer(ans_list, text, match)
+            get = lambda bid: detokenize(
+                [int(t) for t in get_block_tokens(bid)])
         for rank, bid in enumerate(ids[qi]):
-            if _contains(np.asarray(get_block_tokens(int(bid)), np.int64),
-                         ans):
+            if found(get(int(bid))):
                 hits[qi, rank:] = True
                 break
     return {f"top{k}": float(hits[:, k - 1].mean()) for k in topk}
@@ -111,6 +132,10 @@ def main(argv=None):
         g.add_argument("--biencoder_shared_query_context_model",
                        action="store_true")
         g.add_argument("--topk", nargs="*", type=int, default=[1, 5, 20])
+        g.add_argument("--match", choices=["token", "string", "regex"],
+                       default="token",
+                       help="answer-match criterion (string/regex are "
+                            "DPR's, ref tasks/main.py --faiss_match)")
         g.add_argument("--cls_token_id", type=int, default=101)
         g.add_argument("--sep_token_id", type=int, default=102)
         g.add_argument("--pad_token_id", type=int, default=0)
@@ -158,13 +183,23 @@ def main(argv=None):
                 [np.asarray(blocks_ds[i], np.int64) for i in range(s, e)])
         return _cache[bid]
 
+    import ast
+
     questions, answers = [], []
     with open(args.questions) as f:
         for line in f:
             parts = line.rstrip("\n").split("\t")
             if len(parts) >= 2:
                 questions.append(parts[0])
-                answers.append(parts[1])
+                a = parts[1]
+                # NQ-format answer lists ("['a', 'b']", ref nq.py:205 uses
+                # eval; literal_eval here) or a plain string
+                if a.startswith("[") and a.endswith("]"):
+                    try:
+                        a = list(ast.literal_eval(a))
+                    except (ValueError, SyntaxError):
+                        pass
+                answers.append(a)
 
     import jax.numpy as jnp
 
@@ -177,7 +212,8 @@ def main(argv=None):
         questions, answers, tok.tokenize, query_embed, index,
         get_block_tokens,
         max_query_len=model.seq_length, cls_id=args.cls_token_id,
-        sep_id=args.sep_token_id, pad_id=args.pad_token_id, topk=args.topk)
+        sep_id=args.sep_token_id, pad_id=args.pad_token_id, topk=args.topk,
+        match=args.match, detokenize=tok.detokenize)
     for k, v in out.items():
         print(f"{k} retrieval hit rate: {v:.4f} ({len(questions)} questions)")
     return out

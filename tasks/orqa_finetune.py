@@ -134,22 +134,21 @@ class NQSupervisedDataset:
 def _embed_candidates(cfg, params, batch, dropout_key=None):
     """(q [B,D], c [B(1+N),D]) — positives first, then flattened negatives,
     matching the reference's torch.cat([context, neg_context]) order
-    (finetune.py:86-89) so labels are arange(B)."""
+    (finetune.py:86-89) so labels are arange(B). The query/positive pair
+    goes through biencoder_forward; only the negative block is extra."""
     import jax
     import jax.numpy as jnp
 
-    from megatron_tpu.models.biencoder import embed_text
+    from megatron_tpu.models.biencoder import biencoder_forward, embed_text
 
-    qt = params.get("shared", params.get("query"))
-    ct = params.get("shared", params.get("context"))
-    kq = kc = kn = None
+    k_pair = kn = None
     if dropout_key is not None:
-        kq, kc, kn = jax.random.split(dropout_key, 3)
-    q = embed_text(cfg, qt, batch["query_tokens"],
-                   batch["query_pad_mask"] > 0, kq)
-    c = embed_text(cfg, ct, batch["context_tokens"],
-                   batch["context_pad_mask"] > 0, kc)
+        k_pair, kn = jax.random.split(dropout_key)
+    q, c = biencoder_forward(
+        cfg, params, batch["query_tokens"], batch["query_pad_mask"] > 0,
+        batch["context_tokens"], batch["context_pad_mask"] > 0, k_pair)
     if "neg_context_tokens" in batch:
+        ct = params.get("shared", params.get("context"))
         nt = batch["neg_context_tokens"]
         B, N, S = nt.shape
         n = embed_text(cfg, ct, nt.reshape(B * N, S),
@@ -203,8 +202,10 @@ def orqa_eval(loop, valid_ds, batch: int = 8, score_scaling: bool = False,
         if score_scaling:
             scores = scores / jnp.sqrt(
                 jnp.asarray(model_cfg.hidden_size, jnp.float32))
-        # tail batches are padded with copies of row 0; their positive and
-        # negative candidates must not enter any real query's candidate set
+        # two kinds of filler must not enter any real query's candidate
+        # set: tail-batch padding (copies of row 0) and a real sample's
+        # all-pad negative rows (samples with fewer negatives than the
+        # static block; the reference only scores actual negatives)
         scores = jnp.where(col_real[None, :], scores, -jnp.inf)
         labels = jnp.arange(q.shape[0])
         return jnp.sum(scores > jnp.take_along_axis(
@@ -218,8 +219,15 @@ def orqa_eval(loop, valid_ds, batch: int = 8, score_scaling: bool = False,
             n_real = len(rows)
             rows += [rows[0]] * (batch - n_real)
             row_real = np.arange(batch) < n_real
-            col_real = (np.concatenate([row_real, np.repeat(row_real, n_neg)])
-                        if n_neg else row_real)
+            if n_neg:
+                # a negative row is a real candidate only if its sample is
+                # real AND the row is not all-pad filler
+                neg_nonpad = np.stack(
+                    [r["neg_context_pad_mask"].any(-1) for r in rows])
+                col_real = np.concatenate(
+                    [row_real, (neg_nonpad & row_real[:, None]).reshape(-1)])
+            else:
+                col_real = row_real
             vec = np.asarray(rank_vec(loop.state.params,
                                       loop._put_batch(_collate(rows)),
                                       jnp.asarray(col_real)))

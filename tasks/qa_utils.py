@@ -1,0 +1,96 @@
+"""Open-domain QA answer-matching utilities (DPR conventions).
+
+Equivalent of tasks/orqa/unsupervised/qa_utils.py + tokenizers.py (420
+LoC, themselves taken from facebookresearch/DPR): validates whether
+retrieved evidence contains an answer, with the two DPR match types —
+`string` (uncased word-sequence containment after NFD normalization) and
+`regex` (case-insensitive pattern search) — plus the reader-side
+`exact_match_score`. The reference's multiprocessing Pool is dropped
+(matching is O(questions x topk) string work; a fork pool is overhead at
+this granularity, and callers can parallelize outside if needed).
+"""
+
+from __future__ import annotations
+
+import unicodedata
+from typing import Callable, Dict, List, Sequence, Tuple
+
+try:  # the `regex` module handles \p classes + better unicode; fall back
+    import regex as _re
+    _WORD = _re.compile(r"[\p{L}\p{N}\p{M}]+", _re.UNICODE)
+except ImportError:  # pragma: no cover
+    import re as _re
+    _WORD = _re.compile(r"\w+", _re.UNICODE)
+
+from tasks.msdp import normalize_answer as _normalize_answer
+
+
+def _normalize(text: str) -> str:
+    # ref qa_utils.py _normalize:176-177
+    return unicodedata.normalize("NFD", text)
+
+
+def _words(text: str) -> List[str]:
+    """Uncased alphanumeric word stream — the matching-relevant behavior of
+    DPR's SimpleTokenizer (ref tokenizers.py:183-243: punctuation tokens
+    never match answer words, so dropping them is equivalent)."""
+    return [m.group().lower() for m in _WORD.finditer(text)]
+
+
+def regex_match(text: str, pattern: str) -> bool:
+    """ref qa_utils.py:143-152; bad patterns count as no-match."""
+    try:
+        compiled = _re.compile(pattern,
+                               _re.IGNORECASE | _re.UNICODE | _re.MULTILINE)
+    except BaseException:
+        return False
+    return compiled.search(text) is not None
+
+
+def has_answer(answers: Sequence[str], text: str, match_type: str = "string"
+               ) -> bool:
+    """Does `text` contain any of `answers`? (ref qa_utils.py:112-140)"""
+    text = _normalize(text)
+    if match_type == "string":
+        words = _words(text)
+        for answer in answers:
+            ans = _words(_normalize(answer))
+            if not ans:
+                continue
+            n = len(ans)
+            for i in range(0, len(words) - n + 1):
+                if ans == words[i: i + n]:
+                    return True
+        return False
+    if match_type == "regex":
+        return any(regex_match(text, _normalize(a)) for a in answers)
+    raise ValueError(f"unknown match_type {match_type!r}")
+
+
+def exact_match_score(prediction: str, ground_truth: str) -> bool:
+    """SQuAD-style EM after lower/punct/article/whitespace normalization
+    (ref qa_utils.py:156-175; normalization shared with tasks/msdp.py)."""
+    return _normalize_answer(prediction) == _normalize_answer(ground_truth)
+
+
+def calculate_matches(get_doc_text: Callable[[object], str],
+                      answers: List[List[str]],
+                      closest_docs: List[Sequence[object]],
+                      match_type: str = "string"
+                      ) -> Tuple[List[int], List[List[bool]]]:
+    """(top_k_hits, questions_doc_hits) — top_k_hits[k-1] counts questions
+    whose answer appears in their top-k retrievals (ref qa_utils.py:33-85).
+    `get_doc_text` maps a doc id to its text (the reference passes a dict
+    of the whole evidence corpus; a callable keeps lazy corpora lazy)."""
+    n_docs = len(closest_docs[0]) if closest_docs else 0
+    top_k_hits = [0] * n_docs
+    questions_doc_hits: List[List[bool]] = []
+    for ans, doc_ids in zip(answers, closest_docs):
+        hits = [has_answer(ans, get_doc_text(d), match_type)
+                for d in doc_ids]
+        questions_doc_hits.append(hits)
+        best = next((i for i, h in enumerate(hits) if h), None)
+        if best is not None:
+            for k in range(best, n_docs):
+                top_k_hits[k] += 1
+    return top_k_hits, questions_doc_hits

@@ -1,0 +1,88 @@
+"""DPR answer-matching utilities (counterpart: reference
+tasks/orqa/unsupervised/qa_utils.py + tokenizers.py — untested upstream)."""
+
+import numpy as np
+
+from tasks.qa_utils import (
+    calculate_matches, exact_match_score, has_answer, regex_match,
+)
+
+
+def test_string_match_word_sequence():
+    text = "Mount Fuji, at 3,776 m, is the tallest peak in Japan."
+    assert has_answer(["Mount Fuji"], text)
+    assert has_answer(["mount fuji"], text)            # uncased
+    assert has_answer(["tallest peak"], text)
+    assert not has_answer(["Mount Etna"], text)
+    # containment must respect word boundaries, not substrings
+    assert not has_answer(["tall"], text)
+    # multi-answer: any match counts
+    assert has_answer(["Everest", "Japan"], text)
+    # punctuation in the answer is ignored for matching
+    assert has_answer(["3 776 m"], text)
+
+
+def test_string_match_unicode_normalization():
+    # NFD normalization: composed vs decomposed accents must match
+    assert has_answer(["café"], "the café on the corner")
+    assert has_answer(["café"], "the café on the corner")
+
+
+def test_regex_match_mode():
+    text = "The treaty was signed in 1848 in Guadalupe Hidalgo."
+    assert has_answer([r"18\d\d"], text, match_type="regex")
+    assert not has_answer([r"19\d\d"], text, match_type="regex")
+    assert regex_match(text, r"guadalupe")             # case-insensitive
+    assert not regex_match(text, r"[unclosed")         # bad regex = False
+
+
+def test_exact_match_score():
+    assert exact_match_score("The Beatles!", "beatles")
+    assert not exact_match_score("The Rolling Stones", "beatles")
+
+
+def test_calculate_matches_topk_counts():
+    docs = {0: "Paris is the capital of France.",
+            1: "Berlin is the capital of Germany.",
+            2: "Madrid is the capital of Spain."}
+    answers = [["Paris"], ["Germany"], ["Rome"]]
+    closest = [[1, 0, 2],   # Paris found at rank 2
+               [1, 2, 0],   # Germany found at rank 1
+               [0, 1, 2]]   # Rome never found
+    top_k, per_q = calculate_matches(docs.__getitem__, answers, closest)
+    assert top_k == [1, 2, 2]
+    assert per_q[0] == [False, True, False]
+    assert per_q[1] == [True, False, False]
+    assert per_q[2] == [False, False, False]
+
+
+def test_evaluate_retriever_string_mode():
+    """tasks.orqa evaluate_retriever with match=string over a fake
+    detokenizer — DPR text criterion replaces token containment."""
+    from tasks.orqa import evaluate_retriever
+
+    vocab = {5: "paris", 6: "berlin", 7: "capital", 8: "france"}
+
+    def tokenize(s):
+        inv = {v: k for k, v in vocab.items()}
+        return [inv[w] for w in s.lower().split() if w in inv]
+
+    def detok(ids):
+        return " ".join(vocab.get(int(i), "?") for i in ids)
+
+    # two "blocks": block 0 mentions paris, block 1 berlin
+    blocks = {0: np.array([5, 7, 8]), 1: np.array([6, 7])}
+    index = np.asarray([[1.0, 0.0], [0.0, 1.0]], np.float32)
+
+    def query_embed(toks, mask):
+        # route question 0 -> block 0, question 1 -> block 1
+        return np.asarray([[1.0, 0.0], [0.0, 1.0]], np.float32)[: len(toks)]
+
+    out = evaluate_retriever(
+        ["where is paris", "where is berlin"],
+        [["Paris"], ["munich"]],
+        tokenize, query_embed, index, blocks.__getitem__,
+        max_query_len=8, cls_id=1, sep_id=2, pad_id=0, topk=(1, 2),
+        batch_size=2, match="string", detokenize=detok)
+    assert out["top1"] == 0.5   # paris hit at rank 1, munich never
+    assert out["top2"] == 0.5
