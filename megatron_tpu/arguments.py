@@ -115,6 +115,10 @@ def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
     g.add_argument("--sgd_momentum", type=float, default=0.9)
     g.add_argument("--attention_impl", default="xla",
                    choices=["xla", "pallas", "ring", "ulysses"])
+    g.add_argument("--ce_chunk_size", type=int, default=0,
+                   help="compute LM head + cross-entropy over sequence "
+                        "chunks of this many tokens with rematerialized "
+                        "logits (0 = unchunked full [B,S,V] logits)")
     g.add_argument("--use_flash_attn", action="store_true",
                    help="ref alias for --attention_impl pallas")
     g.add_argument("--exit_signal_handler", action="store_true",
@@ -338,6 +342,7 @@ def args_to_run_config(args) -> RunConfig:
         overrides["attention_dropout"] = args.attention_dropout
         overrides["lima_dropout"] = args.lima_dropout
         overrides["attention_impl"] = args.attention_impl
+        overrides["ce_chunk_size"] = args.ce_chunk_size
         overrides["params_dtype"] = _dtype_name(args)
         if args.tie_embed_logits is not None:  # explicit (no_)tie flag
             overrides["tie_embed_logits"] = args.tie_embed_logits
@@ -385,6 +390,7 @@ def args_to_run_config(args) -> RunConfig:
             init_method_std=args.init_method_std,
             params_dtype=_dtype_name(args),
             attention_impl=args.attention_impl,
+            ce_chunk_size=args.ce_chunk_size,
         ).validate()
 
     vpp = None

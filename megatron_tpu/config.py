@@ -133,6 +133,13 @@ class ModelConfig:
     softmax_fp32: bool = True
     attn_mask_type: str = "causal"
 
+    # chunked fused logits+cross-entropy (beyond the reference): compute
+    # the LM head and CE over sequence chunks of this many tokens, with
+    # per-chunk logits rematerialized in the backward — the full [B,S,V]
+    # logits buffer (plus its fp32 CE intermediates and gradient) never
+    # lives in HBM. 0 = unchunked. Must divide seq_length.
+    ce_chunk_size: int = 0
+
     # attention implementation: "xla" einsum path, "pallas" flash kernel
     # (falls back to xla for unsupported shapes), or "ring" context-parallel
     # ring attention (requires an ambient mesh with a "context" axis).
@@ -200,6 +207,12 @@ class ModelConfig:
                 raise ValueError(
                     f"moe_top_k={self.moe_top_k} must be in "
                     f"[1, num_experts={self.num_experts}]")
+        if self.ce_chunk_size < 0:
+            raise ValueError("ce_chunk_size must be >= 0")
+        if self.ce_chunk_size and self.seq_length % self.ce_chunk_size:
+            raise ValueError(
+                f"ce_chunk_size={self.ce_chunk_size} must divide "
+                f"seq_length={self.seq_length}")
         return self
 
     # FLOPs per token for one fwd pass, used for MFU accounting

@@ -179,6 +179,46 @@ def lm_forward(
     return logits
 
 
+def chunked_lm_loss_tokens(
+    cfg: ModelConfig,
+    params: Dict[str, Any],
+    hidden: jnp.ndarray,           # [B, S, H] final-norm'd hidden states
+    labels: jnp.ndarray,           # [B, S]
+    sharder: Sharder = _identity_sharder,
+) -> jnp.ndarray:
+    """Per-token CE [B, S] computed over sequence chunks of
+    cfg.ce_chunk_size tokens, LM head included, with per-chunk logits
+    REMATERIALIZED in the backward — the [B, S, V] logits buffer (bf16
+    forward copy, fp32 CE intermediates, and its gradient) never resides
+    in HBM; peak extra memory is one [B, C, V] chunk.
+
+    Beyond the reference (which materializes full logits,
+    gpt_model.py:18-42); exact same numbers as the unchunked path — the
+    softmax is complete within a chunk because CE is independent per
+    token, only the sequence axis is split."""
+    B, S, H = hidden.shape
+    C = cfg.ce_chunk_size
+    n = S // C
+
+    def chunk_loss(h_c, y_c):
+        # h_c [B, C, H], y_c [B, C] -> per-token loss [B, C]
+        logits = sharder(lm_logits(cfg, params, h_c), "logits")
+        return cross_entropy_loss(logits, y_c)[1]
+
+    # remat: backward recomputes the chunk's logits from h_c instead of
+    # storing them (the whole point of chunking)
+    chunk_loss = jax.checkpoint(chunk_loss, prevent_cse=False)
+
+    def body(_, xs):
+        h_c, y_c = xs
+        return None, chunk_loss(h_c, y_c)
+
+    h_chunks = hidden.reshape(B, n, C, H).transpose(1, 0, 2, 3)
+    y_chunks = labels.reshape(B, n, C).transpose(1, 0, 2)
+    _, per_chunk = jax.lax.scan(body, None, (h_chunks, y_chunks))
+    return per_chunk.transpose(1, 0, 2).reshape(B, S)
+
+
 def lm_loss(
     cfg: ModelConfig,
     params: Dict[str, Any],
@@ -194,6 +234,12 @@ def lm_loss(
     (gpt_model.py post_language_model_processing + finetune.py loss_func).
     """
     moe = cfg.num_experts is not None
+    S = batch["tokens"].shape[1]
+    # fall back to unchunked when the chunk doesn't tile this batch's
+    # sequence (variable_seq_lengths batches may be shorter than
+    # seq_length). C == S still chunks: the single remat'd chunk drops the
+    # forward logits copy.
+    chunked = bool(cfg.ce_chunk_size) and S % cfg.ce_chunk_size == 0
     out = lm_forward(
         cfg, params, batch["tokens"],
         positions=batch.get("position_ids"),
@@ -201,10 +247,21 @@ def lm_loss(
         recompute=recompute,
         sharder=sharder,
         return_moe_aux=moe,
+        return_hidden=chunked,
     )
-    logits, moe_aux = out if moe else (out, None)
-    mean, per_token = cross_entropy_loss(
-        logits, batch["labels"], loss_mask=batch.get("loss_mask"))
+    if chunked:
+        hidden, moe_aux = out if moe else (out, None)
+        per_token = chunked_lm_loss_tokens(
+            cfg, params, hidden, batch["labels"], sharder=sharder)
+        if "loss_mask" in batch:
+            m = batch["loss_mask"].astype(jnp.float32)
+            mean = jnp.sum(per_token * m) / jnp.maximum(jnp.sum(m), 1.0)
+        else:
+            mean = jnp.mean(per_token)
+    else:
+        logits, moe_aux = out if moe else (out, None)
+        mean, per_token = cross_entropy_loss(
+            logits, batch["labels"], loss_mask=batch.get("loss_mask"))
     ntokens = (jnp.sum(batch["loss_mask"]) if "loss_mask" in batch
                else jnp.asarray(per_token.size, jnp.float32))
     aux = {"lm_loss": mean, "ntokens": ntokens}

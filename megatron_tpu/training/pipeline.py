@@ -291,12 +291,21 @@ def make_pipeline_loss_fn(
 
                 def with_loss(_):
                     h = final_hidden_norm(model_cfg, params_local, out)
-                    logits = lm_logits(model_cfg, params_local, h)
                     lab = jax.lax.dynamic_index_in_dim(labels, m, 0,
                                                        keepdims=False)
                     lm = jax.lax.dynamic_index_in_dim(loss_mask, m, 0,
                                                       keepdims=False)
-                    _, per_tok = cross_entropy_loss(logits, lab)
+                    C = model_cfg.ce_chunk_size
+                    if C and S % C == 0:
+                        from megatron_tpu.models.language_model import (
+                            chunked_lm_loss_tokens,
+                        )
+
+                        per_tok = chunked_lm_loss_tokens(
+                            model_cfg, params_local, h, lab)
+                    else:
+                        logits = lm_logits(model_cfg, params_local, h)
+                        _, per_tok = cross_entropy_loss(logits, lab)
                     return jnp.sum(per_tok * lm), jnp.sum(lm)
 
                 def without_loss(_):

@@ -95,9 +95,9 @@ def evaluate_retriever(
         ans_list = (answers[qi] if isinstance(answers[qi], (list, tuple))
                     else [answers[qi]])
         if match == "token":
-            toks = [tokenize(a) for a in ans_list]
+            ans_toks = [tokenize(a) for a in ans_list]
             found = lambda block: any(
-                _contains(block, t) for t in toks if t)
+                _contains(block, t) for t in ans_toks if t)
             get = lambda bid: np.asarray(get_block_tokens(bid), np.int64)
         else:
             from tasks.qa_utils import has_answer

@@ -17,10 +17,13 @@ from typing import Callable, Dict, List, Sequence, Tuple
 
 try:  # the `regex` module handles \p classes + better unicode; fall back
     import regex as _re
-    _WORD = _re.compile(r"[\p{L}\p{N}\p{M}]+", _re.UNICODE)
+    # DPR SimpleTokenizer: alphanumeric runs OR single non-space chars —
+    # punctuation stays a token, so it breaks multi-word answer adjacency
+    # ('New York' must not match 'New-York'); ref tokenizers.py:183-243
+    _TOKEN = _re.compile(r"[\p{L}\p{N}\p{M}]+|[^\p{Z}\p{C}]", _re.UNICODE)
 except ImportError:  # pragma: no cover
     import re as _re
-    _WORD = _re.compile(r"\w+", _re.UNICODE)
+    _TOKEN = _re.compile(r"\w+|[^\w\s]", _re.UNICODE)
 
 from tasks.msdp import normalize_answer as _normalize_answer
 
@@ -31,10 +34,9 @@ def _normalize(text: str) -> str:
 
 
 def _words(text: str) -> List[str]:
-    """Uncased alphanumeric word stream — the matching-relevant behavior of
-    DPR's SimpleTokenizer (ref tokenizers.py:183-243: punctuation tokens
-    never match answer words, so dropping them is equivalent)."""
-    return [m.group().lower() for m in _WORD.finditer(text)]
+    """Uncased token stream (words AND punctuation) — matching-equivalent
+    to DPR's SimpleTokenizer .words(uncased=True)."""
+    return [m.group().lower() for m in _TOKEN.finditer(text)]
 
 
 def regex_match(text: str, pattern: str) -> bool:

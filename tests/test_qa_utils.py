@@ -18,8 +18,10 @@ def test_string_match_word_sequence():
     assert not has_answer(["tall"], text)
     # multi-answer: any match counts
     assert has_answer(["Everest", "Japan"], text)
-    # punctuation in the answer is ignored for matching
-    assert has_answer(["3 776 m"], text)
+    # DPR keeps punctuation as tokens: it breaks multi-word adjacency
+    assert not has_answer(["3 776 m"], text)      # text has '3,776'
+    assert has_answer(["3,776 m"], text)          # exact token sequence
+    assert not has_answer(["New York"], "in New-York city")
 
 
 def test_string_match_unicode_normalization():

@@ -20,7 +20,7 @@ from bench import build_step, headline_config, is_oom, time_step
 
 
 def run_one(micro_bs, granularity, seq_length=2048, iters=5,
-            num_experts=None, moe_top_k=2):
+            num_experts=None, moe_top_k=2, ce_chunk=0):
     import jax
 
     from megatron_tpu.platform import peak_bf16_flops
@@ -34,6 +34,12 @@ def run_one(micro_bs, granularity, seq_length=2048, iters=5,
         cfg = dataclasses.replace(
             cfg, num_experts=num_experts, moe_top_k=moe_top_k,
             ffn_hidden_size=cfg.ffn_size // num_experts).validate()
+    if ce_chunk:
+        # chunked fused logits+CE: drops the [B,S,V] logits residency,
+        # the likely OOM driver at mbs 8 / recompute none
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, ce_chunk_size=ce_chunk).validate()
     state, step, batch = build_step(cfg, micro_bs, granularity)
     try:
         dt, _, state = time_step(state, step, batch, iters=iters)
@@ -51,6 +57,8 @@ def run_one(micro_bs, granularity, seq_length=2048, iters=5,
            "mfu": round(achieved / peak, 4)}
     if num_experts:
         out["experts"] = f"{num_experts}top{moe_top_k}"
+    if ce_chunk:
+        out["ce_chunk"] = ce_chunk
     return out
 
 
@@ -62,11 +70,14 @@ def main():
     ap.add_argument("--experts", type=int, default=None,
                     help="bench the iso-param MoE variant with N experts")
     ap.add_argument("--topk", type=int, default=2)
+    ap.add_argument("--ce_chunk", type=int, default=0,
+                    help="chunked logits+CE chunk size (0 = unchunked)")
     args = ap.parse_args()
     for g in args.recompute:
         for mbs in sorted(args.micro_bs):
             out = run_one(mbs, g, args.seq_length,
-                          num_experts=args.experts, moe_top_k=args.topk)
+                          num_experts=args.experts, moe_top_k=args.topk,
+                          ce_chunk=args.ce_chunk)
             print(json.dumps(out), flush=True)
             if out.get("oom"):
                 break  # ascending order: every larger mbs will OOM too
