@@ -97,6 +97,44 @@ def is_oom(e: Exception) -> bool:
     return "RESOURCE_EXHAUSTED" in str(e) or "memory" in str(e).lower()
 
 
+# operating points searched by main(), best MFU wins. First entry is the
+# round-2 verified point (mbs 4, selective, 0.5303 MFU) so even a
+# quick/degraded run reports a sane number; the chunked-CE variants free
+# the ~2 GB [B,S,V] logits residency and may unlock recompute=none or
+# mbs 8 (sweep showed both OOM unchunked).
+CANDIDATES = (
+    dict(micro_bs=4, granularity="selective", ce_chunk=0),
+    dict(micro_bs=4, granularity="none", ce_chunk=512),
+    dict(micro_bs=8, granularity="selective", ce_chunk=512),
+    dict(micro_bs=4, granularity="selective", ce_chunk=512),
+    dict(micro_bs=8, granularity="selective", ce_chunk=0),
+)
+
+
+def _cfg_for(cfg, ce_chunk):
+    """Apply a candidate's config variant (single source for measuring AND
+    profiling — they must never diverge)."""
+    import dataclasses
+
+    if ce_chunk:
+        return dataclasses.replace(cfg, ce_chunk_size=ce_chunk).validate()
+    return cfg
+
+
+def _measure(cfg, micro_bs, granularity, ce_chunk, iters=5):
+    """(dt, loss) or raises; applies the chunked-CE variant."""
+    import gc
+
+    cfg = _cfg_for(cfg, ce_chunk)
+    state, step, batch = build_step(cfg, micro_bs, granularity)
+    try:
+        dt, loss, state = time_step(state, step, batch, iters=iters)
+        return dt, loss
+    finally:
+        del state, step, batch
+        gc.collect()
+
+
 def main():
     import jax
 
@@ -105,66 +143,95 @@ def main():
 
     cfg = headline_config()
     n_params = num_params(cfg)
-    micro_bs = 4
-
-    # try no recompute first (fastest when activations fit HBM), fall back
-    # to selective on OOM
-    result = None
-    for granularity in ("none", "selective"):
-        state, step, batch = build_step(cfg, micro_bs, granularity)
-        profile_dir = os.environ.get("MEGATRON_TPU_PROFILE_DIR")
-        profiling = False
-        try:
-            if profile_dir:
-                # compile + warm up before the trace; the step donates its
-                # state, so thread the returned state into the timed loop
-                _, _, state = time_step(state, step, batch, iters=1)
-                jax.profiler.start_trace(profile_dir)
-                profiling = True
-            dt, loss_val, state = time_step(state, step, batch)
-            if profiling:
-                jax.profiler.stop_trace()
-                profiling = False
-            result = (granularity, dt, loss_val)
-            break
-        except Exception as e:  # XlaRuntimeError OOM etc.
-            if profiling:
-                jax.profiler.stop_trace()
-                profiling = False
-            if not is_oom(e):
-                raise
-            del state, step  # free the failed attempt before the fallback
-            print(f"# recompute={granularity} OOM, retrying", file=sys.stderr)
-    if result is None:
-        raise RuntimeError("both recompute granularities OOMed")
-    recompute, dt, loss_val = result
-
-    tokens_per_sec = micro_bs * cfg.seq_length / dt
-    flops_per_token = 3.0 * cfg.flops_per_token_fwd()  # fwd + bwd(2x)
-    achieved = tokens_per_sec * flops_per_token
-
     dev = jax.devices()[0]
     kind = getattr(dev, "device_kind", str(dev)).lower()
     peak = peak_bf16_flops(dev)
-    mfu = achieved / peak
+    flops_per_token = 3.0 * cfg.flops_per_token_fwd()  # fwd + bwd(2x)
 
-    print(json.dumps({
-        "metric": "llama_train_step_mfu",
-        "value": round(mfu, 4),
-        "unit": "fraction_of_peak_bf16",
-        "vs_baseline": round(mfu / BASELINE_MFU, 3),
-        "detail": {
-            "tokens_per_sec_per_chip": round(tokens_per_sec),
-            "step_ms": round(dt * 1e3, 2),
-            "n_params": n_params,
-            "loss": loss_val,
-            "device": str(dev),
-            "device_kind": kind,
-            "peak_flops_assumed": peak,
-            "recompute": recompute,
-            "attention": "pallas(splash)",
-        },
-    }))
+    quick = bool(os.environ.get("MEGATRON_TPU_BENCH_QUICK"))
+    candidates = CANDIDATES[:1] if quick else CANDIDATES
+    # stop starting new candidates past this elapsed budget so the one
+    # JSON line always lands inside the driver's timeout
+    budget_s = float(os.environ.get("MEGATRON_TPU_BENCH_BUDGET_S", "420"))
+
+    best = None        # (mfu, cand, dt, loss)
+    sweep = []
+
+    def emit_best():
+        """Print the one-line JSON for the best point found so far."""
+        mfu, cand, dt, loss_val = best
+        tokens_per_sec = cand["micro_bs"] * cfg.seq_length / dt
+        print(json.dumps({
+            "metric": "llama_train_step_mfu",
+            "value": round(mfu, 4),
+            "unit": "fraction_of_peak_bf16",
+            "vs_baseline": round(mfu / BASELINE_MFU, 3),
+            "detail": {
+                "tokens_per_sec_per_chip": round(tokens_per_sec),
+                "step_ms": round(dt * 1e3, 2),
+                "n_params": n_params,
+                "loss": loss_val,
+                "device": str(dev),
+                "device_kind": kind,
+                "peak_flops_assumed": peak,
+                "micro_bs": cand["micro_bs"],
+                "recompute": cand["granularity"],
+                "ce_chunk": cand["ce_chunk"],
+                "attention": "pallas(splash)",
+                "sweep": sweep,
+            },
+        }), flush=True)
+
+    # if the driver times the process out mid-search, flush the best
+    # measured point instead of losing the round's number entirely
+    import signal
+
+    def on_term(signum, frame):
+        if best is not None:
+            emit_best()
+        sys.exit(0 if best is not None else 1)
+
+    signal.signal(signal.SIGTERM, on_term)
+
+    t_start = time.perf_counter()
+    for cand in candidates:
+        if best is not None and time.perf_counter() - t_start > budget_s:
+            print("# bench budget reached, stopping search", file=sys.stderr)
+            break
+        try:
+            dt, loss = _measure(cfg, **cand)
+        except Exception as e:
+            if not is_oom(e):
+                raise
+            sweep.append({**cand, "oom": True})
+            print(f"# {cand} OOM", file=sys.stderr)
+            continue
+        tps = cand["micro_bs"] * cfg.seq_length / dt
+        mfu = tps * flops_per_token / peak
+        sweep.append({**cand, "mfu": round(mfu, 4),
+                      "step_ms": round(dt * 1e3, 2)})
+        print(f"# {cand} mfu={mfu:.4f}", file=sys.stderr)
+        if best is None or mfu > best[0]:
+            best = (mfu, cand, dt, loss)
+    if best is None:
+        raise RuntimeError("every bench operating point OOMed")
+    mfu, cand, dt, loss_val = best
+
+    profile_dir = os.environ.get("MEGATRON_TPU_PROFILE_DIR")
+    if profile_dir:
+        # re-run the winner under the profiler (trace excludes compile)
+        state, step, batch = build_step(_cfg_for(cfg, cand["ce_chunk"]),
+                                        cand["micro_bs"],
+                                        cand["granularity"])
+        _, _, state = time_step(state, step, batch, iters=1)
+        jax.profiler.start_trace(profile_dir)
+        try:
+            time_step(state, step, batch, iters=3)
+        finally:
+            jax.profiler.stop_trace()
+
+    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+    emit_best()
 
 
 if __name__ == "__main__":

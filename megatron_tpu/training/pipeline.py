@@ -48,8 +48,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from megatron_tpu.config import ModelConfig
 from megatron_tpu.models.language_model import (
-    _dropout, _layer_dropout_rates, final_hidden_norm, lm_logits,
-    _remat_policy,
+    _dropout, _layer_dropout_rates, chunked_lm_loss_tokens,
+    final_hidden_norm, lm_logits, _remat_policy,
 )
 from megatron_tpu.models.transformer import block_forward
 from megatron_tpu.ops.cross_entropy import cross_entropy_loss
@@ -297,10 +297,6 @@ def make_pipeline_loss_fn(
                                                       keepdims=False)
                     C = model_cfg.ce_chunk_size
                     if C and S % C == 0:
-                        from megatron_tpu.models.language_model import (
-                            chunked_lm_loss_tokens,
-                        )
-
                         per_tok = chunked_lm_loss_tokens(
                             model_cfg, params_local, h, lab)
                     else:

@@ -1,0 +1,69 @@
+"""bench.py contract: the driver parses exactly one JSON line from stdout
+with metric/value/unit/vs_baseline. Run the full candidate search at a
+tiny geometry (headline geometry monkeypatched) so the selection logic,
+OOM handling shape, and output schema are exercised hermetically."""
+
+import io
+import json
+from contextlib import redirect_stdout
+
+import numpy as np
+
+
+def test_bench_main_emits_one_json_line(monkeypatch):
+    import bench
+    from megatron_tpu.models import presets
+
+    for var in ("MEGATRON_TPU_BENCH_QUICK", "MEGATRON_TPU_BENCH_BUDGET_S",
+                "MEGATRON_TPU_PROFILE_DIR"):
+        monkeypatch.delenv(var, raising=False)
+
+    def tiny_headline(seq_length=2048):
+        return presets.tiny(vocab_size=128, seq_length=64, hidden_size=32,
+                            num_layers=2, num_attention_heads=4,
+                            num_kv_heads=2, ffn_hidden_size=64,
+                            params_dtype="float32")
+
+    monkeypatch.setattr(bench, "headline_config", tiny_headline)
+    # keep runtime sane on CPU: two candidates, 1 timed iter
+    monkeypatch.setattr(bench, "CANDIDATES", (
+        dict(micro_bs=2, granularity="selective", ce_chunk=0),
+        dict(micro_bs=2, granularity="selective", ce_chunk=16),
+    ))
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        bench.main()
+    lines = [l for l in buf.getvalue().splitlines() if l.strip()]
+    assert len(lines) == 1
+    out = json.loads(lines[0])
+    assert out["metric"] == "llama_train_step_mfu"
+    assert set(out) >= {"metric", "value", "unit", "vs_baseline", "detail"}
+    # tiny-on-CPU MFU rounds to ~0; the contract is shape, not magnitude
+    assert out["value"] >= 0 and np.isfinite(out["value"])
+    d = out["detail"]
+    assert d["micro_bs"] == 2 and d["recompute"] == "selective"
+    assert len(d["sweep"]) == 2
+    assert all(("mfu" in s) or s.get("oom") for s in d["sweep"])
+
+
+def test_bench_quick_mode(monkeypatch):
+    import bench
+    from megatron_tpu.models import presets
+
+    monkeypatch.delenv("MEGATRON_TPU_PROFILE_DIR", raising=False)
+    monkeypatch.setenv("MEGATRON_TPU_BENCH_QUICK", "1")
+    monkeypatch.setattr(bench, "headline_config",
+                        lambda seq_length=2048: presets.tiny(
+                            vocab_size=128, seq_length=64, hidden_size=32,
+                            num_layers=2, num_attention_heads=4,
+                            num_kv_heads=2, ffn_hidden_size=64,
+                            params_dtype="float32"))
+    monkeypatch.setattr(bench, "CANDIDATES", (
+        dict(micro_bs=2, granularity="selective", ce_chunk=0),
+        dict(micro_bs=999, granularity="none", ce_chunk=0),  # must NOT run
+    ))
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        bench.main()
+    out = json.loads(buf.getvalue().strip())
+    assert len(out["detail"]["sweep"]) == 1
