@@ -55,6 +55,7 @@ def generate_and_post_process(
     return_output_log_probs: bool = False,
     random_seed: int = 0,
     forward_fn=None,
+    kv_cache_int8: bool = False,
 ):
     """(texts, segments, logprobs, tokens) like the reference's
     generate_and_post_process (api.py:19-90). forward_fn plugs in the
@@ -74,7 +75,8 @@ def generate_and_post_process(
         max_new_tokens=tokens_to_generate,
         temperature=temperature, top_k=top_k_sampling, top_p=top_p_sampling,
         vocab_size=tokenizer.vocab_size, eod=tokenizer.eod, seed=random_seed,
-        want_logprobs=return_output_log_probs, forward_fn=forward_fn)
+        want_logprobs=return_output_log_probs, forward_fn=forward_fn,
+        kv_cache_int8=kv_cache_int8)
 
     texts, segments = [], []
     for row, end in zip(out.tokens, out.lengths):
@@ -94,6 +96,7 @@ def beam_search_and_post_process(
     beam_size: int = 4,
     add_BOS: bool = False,
     length_penalty: float = 1.0,
+    kv_cache_int8: bool = False,
 ):
     """(texts, segments, scores) — ref api.py:147-201 (batch of 1 only)."""
     if len(prompts) != 1:
@@ -103,7 +106,8 @@ def beam_search_and_post_process(
     beams, scores = beam_search_tokens(
         cfg, params, prompt_tokens[0, :int(lengths[0])],
         max_new_tokens=tokens_to_generate, beam_size=beam_size,
-        eod=tokenizer.eod, length_penalty=length_penalty)
+        eod=tokenizer.eod, length_penalty=length_penalty,
+        kv_cache_int8=kv_cache_int8)
     texts = [tokenizer.detokenize(b) for b in beams]
     segments = [[tokenizer.detokenize([t]) for t in b] for b in beams]
     return texts, segments, scores

@@ -34,8 +34,15 @@ class GenerationOutput:
     logprobs: np.ndarray     # [B, total_len-1] logprob of each emitted token
 
 
-def _init_caches(cfg: ModelConfig, batch: int, total_len: int):
+def _init_caches(cfg: ModelConfig, batch: int, total_len: int,
+                 int8: bool = False):
     shape = (cfg.num_layers, batch, total_len, cfg.n_kv_heads, cfg.head_dim)
+    if int8:
+        # (k_q, v_q, k_scale, v_scale) — half the bytes of a bf16 cache;
+        # format is detected by tuple arity in attention_block
+        sshape = shape[:-1] + (1,)
+        return (jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8),
+                jnp.zeros(sshape, jnp.float32), jnp.zeros(sshape, jnp.float32))
     return (jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
 
 
@@ -53,7 +60,8 @@ def _default_fwd(cfg):
 @partial(jax.jit, static_argnames=("cfg", "total_len", "prefill_len",
                                    "temperature", "top_k",
                                    "top_p", "vocab_size", "eod",
-                                   "want_logprobs", "forward_fn"))
+                                   "want_logprobs", "forward_fn",
+                                   "kv_cache_int8"))
 def _generate_jit(
     cfg: ModelConfig,
     params: Any,
@@ -69,11 +77,12 @@ def _generate_jit(
     eod: Optional[int],
     want_logprobs: bool = True,
     forward_fn=None,
+    kv_cache_int8: bool = False,
 ):
     fwd = forward_fn or _default_fwd(cfg)
     B = tokens.shape[0]
     min_len = jnp.min(lengths)
-    caches = _init_caches(cfg, B, total_len)
+    caches = _init_caches(cfg, B, total_len, int8=kv_cache_int8)
 
     # Prefill the prompt region in one pass — the reference likewise batches
     # the common prompt prefix. min_len is dynamic, so the prefill runs a
@@ -162,7 +171,12 @@ def generate_tokens(
     seed: int = 0,
     want_logprobs: bool = True,
     forward_fn=None,
+    kv_cache_int8: bool = False,
 ) -> GenerationOutput:
+    if kv_cache_int8 and forward_fn is not None:
+        raise ValueError(
+            "kv_cache_int8 is supported on the single-stage forward only "
+            "(the pipelined pp>1 forward threads bf16 cache pairs)")
     B, max_prompt = prompts.shape
     total_len = max_prompt + max_new_tokens
     if (cfg.position_embedding_type == "absolute"
@@ -180,7 +194,7 @@ def generate_tokens(
         cfg, params, jnp.asarray(tokens), jnp.asarray(lengths, jnp.int32),
         jax.random.PRNGKey(seed), total_len, prefill_len, float(temperature),
         int(top_k), float(top_p), vocab_size, eod, want_logprobs,
-        forward_fn)
+        forward_fn, bool(kv_cache_int8))
     return GenerationOutput(tokens=np.asarray(toks), lengths=np.asarray(ends),
                             logprobs=np.asarray(lp))
 
@@ -203,10 +217,13 @@ def beam_search_tokens(
     beam_size: int,
     eod: int,
     length_penalty: float = 1.0,
+    kv_cache_int8: bool = False,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Beam search for one prompt (the reference's beam path also requires
     batch 1, text_generation/api.py:147). Host-side loop over a jitted
-    scoring step; returns (beams [beam_size, total], scores [beam_size])."""
+    scoring step; returns (beams [beam_size, total], scores [beam_size]).
+    The per-beam cache gathers are tree-mapped, so the int8 cache tuple
+    flows through unchanged."""
     prompt = np.asarray(prompt, np.int32)
     plen = len(prompt)
     total = plen + max_new_tokens
@@ -216,7 +233,7 @@ def beam_search_tokens(
     # prefill the prompt once at batch 1, tile the caches across beams, then
     # one single-token forward per emitted token with per-beam cache
     # reordering (gather over the batch axis) at each step.
-    caches = _init_caches(cfg, 1, total)
+    caches = _init_caches(cfg, 1, total, int8=kv_cache_int8)
     prefill_logits, caches = lm_forward(
         cfg, params, jnp.asarray(prompt)[None, :],
         positions=jnp.arange(plen)[None, :], kv_caches=caches, cache_index=0)

@@ -34,7 +34,7 @@ MAX_PROMPTS = 128
 
 class GenerationService:
     def __init__(self, cfg: ModelConfig, params: Any, tokenizer,
-                 mesh=None, forward_fn=None):
+                 mesh=None, forward_fn=None, kv_cache_int8=False):
         """mesh + forward_fn serve sharded models: the mesh becomes
         ambient around generation (GSPMD handles tp/cp), forward_fn is the
         pp>1 pipelined forward (ref ForwardStep, forward_step.py:45-204)."""
@@ -43,6 +43,7 @@ class GenerationService:
         self.tokenizer = tokenizer
         self.mesh = mesh
         self.forward_fn = forward_fn
+        self.kv_cache_int8 = kv_cache_int8
         self.lock = threading.Lock()
 
     def _mesh_scope(self):
@@ -72,7 +73,8 @@ class GenerationService:
                     tokens_to_generate=n,
                     beam_size=int(req["beam_width"]),
                     add_BOS=bool(req.get("add_BOS", False)),
-                    length_penalty=float(req.get("length_penalty", 1.0)))
+                    length_penalty=float(req.get("length_penalty", 1.0)),
+                    kv_cache_int8=self.kv_cache_int8)
                 return {"text": texts, "segments": segments,
                         "scores": [float(s) for s in scores]}
             texts, segments, logprobs, _ = generate_and_post_process(
@@ -84,7 +86,8 @@ class GenerationService:
                 add_BOS=bool(req.get("add_BOS", False)),
                 return_output_log_probs=bool(req.get("logprobs", False)),
                 random_seed=int(req.get("random_seed", 0)),
-                forward_fn=self.forward_fn)
+                forward_fn=self.forward_fn,
+                kv_cache_int8=self.kv_cache_int8)
             out = {"text": texts, "segments": segments}
             if logprobs is not None:
                 out["logprobs"] = [list(map(float, row)) for row in logprobs]
@@ -122,9 +125,10 @@ def make_handler(service: GenerationService):
 
 def run_server(cfg: ModelConfig, params: Any, tokenizer,
                host: str = "0.0.0.0", port: int = 5000,
-               mesh=None, forward_fn=None) -> None:
+               mesh=None, forward_fn=None, kv_cache_int8=False) -> None:
     service = GenerationService(cfg, params, tokenizer, mesh=mesh,
-                                forward_fn=forward_fn)
+                                forward_fn=forward_fn,
+                                kv_cache_int8=kv_cache_int8)
     server = ThreadingHTTPServer((host, port), make_handler(service))
     print(f"serving generation API on http://{host}:{port}/api")
     server.serve_forever()

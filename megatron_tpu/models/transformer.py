@@ -81,7 +81,25 @@ def attention_block(
         q, k = apply_rotary_emb(q, k, rope[0], rope[1], positions)
 
     q_offset = 0
-    if kv_cache is not None:
+    if kv_cache is not None and len(kv_cache) == 4:
+        # int8 KV cache (serving option): quantize the new K/V slice on
+        # write, dequantize the whole cache for attention — cache bytes
+        # halve vs bf16 (ops/kv_quant.py)
+        from megatron_tpu.ops.kv_quant import dequantize_kv, quantize_kv
+
+        kq, vq, ks, vs = kv_cache
+        knew, ksnew = quantize_kv(k)
+        vnew, vsnew = quantize_kv(v)
+        at = (0, cache_index, 0, 0)
+        kq = jax.lax.dynamic_update_slice(kq, knew, at)
+        vq = jax.lax.dynamic_update_slice(vq, vnew, at)
+        ks = jax.lax.dynamic_update_slice(ks, ksnew.astype(ks.dtype), at)
+        vs = jax.lax.dynamic_update_slice(vs, vsnew.astype(vs.dtype), at)
+        k = dequantize_kv(kq, ks, cfg.dtype)
+        v = dequantize_kv(vq, vs, cfg.dtype)
+        kv_cache = (kq, vq, ks, vs)
+        q_offset = cache_index
+    elif kv_cache is not None:
         # functional KV cache: fixed-size [B, max_seq, nkv, D] buffers,
         # in-place slice update at cache_index (donated under jit).
         kc, vc = kv_cache

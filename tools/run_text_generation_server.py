@@ -22,6 +22,9 @@ def extra_args(parser):
     g = parser.add_argument_group("server")
     g.add_argument("--host", default="0.0.0.0")
     g.add_argument("--port", type=int, default=5000)
+    g.add_argument("--kv_cache_int8", action="store_true",
+                   help="serve with an int8-quantized KV cache (half the "
+                        "cache HBM -> 2x context/batch per chip)")
     return parser
 
 
@@ -65,12 +68,18 @@ def main(argv=None):
         params = shard_tree(rt, params, param_specs(cfg.model))
         mesh = rt.mesh
         if rt.pp > 1:
+            if args.kv_cache_int8:
+                raise SystemExit(
+                    "--kv_cache_int8 is not supported with pipeline-parallel "
+                    "serving (the pp>1 forward threads bf16 cache pairs); "
+                    "drop one of the two flags")
             forward_fn = make_pipelined_lm_forward(cfg.model, rt.mesh, rt.pp)
         print(f"serving sharded: mesh={dict(rt.mesh.shape)}"
               + (" (pipelined forward)" if forward_fn else ""))
 
     run_server(cfg.model, params, tokenizer, host=args.host, port=args.port,
-               mesh=mesh, forward_fn=forward_fn)
+               mesh=mesh, forward_fn=forward_fn,
+               kv_cache_int8=args.kv_cache_int8)
 
 
 if __name__ == "__main__":
