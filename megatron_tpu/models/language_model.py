@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from megatron_tpu.config import ModelConfig
 from megatron_tpu.models.transformer import Sharder, _dropout, _identity_sharder, block_forward
 from megatron_tpu.ops.cross_entropy import cross_entropy_loss
+from megatron_tpu.ops.weight_quant import deq, take_rows
 from megatron_tpu.ops.normalization import norm_forward
 from megatron_tpu.ops.rotary import precompute_rope
 
@@ -62,7 +63,7 @@ def embed_tokens(
 ) -> jnp.ndarray:
     """Token (+ absolute position, + tokentype) embedding with embedding
     dropout (ref: language_model.py:133-262 Embedding)."""
-    x = jnp.take(params["embed"]["tokens"], tokens, axis=0)
+    x = take_rows(params["embed"]["tokens"], tokens, cfg.dtype)
     if cfg.position_embedding_type == "absolute":
         pos = positions if positions is not None else jnp.arange(tokens.shape[1])[None, :]
         x = x + jnp.take(params["embed"]["pos"], pos, axis=0)
@@ -88,9 +89,9 @@ def lm_logits(cfg: ModelConfig, params: Dict[str, Any], x: jnp.ndarray) -> jnp.n
     """Project hidden states to vocab logits, tied or untied
     (ref: parallel_lm_logits, language_model.py:24-53)."""
     if cfg.tie_embed_logits:
-        w = params["embed"]["tokens"]  # [V, h]
+        w = deq(params["embed"]["tokens"], x.dtype)  # [V, h]
         return jnp.einsum("bsh,vh->bsv", x, w)
-    return jnp.einsum("bsh,hv->bsv", x, params["lm_head"]["w"])
+    return jnp.einsum("bsh,hv->bsv", x, deq(params["lm_head"]["w"], x.dtype))
 
 
 def lm_forward(

@@ -29,6 +29,7 @@ from megatron_tpu.ops.attention import attention
 from megatron_tpu.ops.moe import moe_block
 from megatron_tpu.ops.normalization import norm_forward
 from megatron_tpu.ops.rotary import apply_rotary_emb
+from megatron_tpu.ops.weight_quant import deq
 
 Sharder = Callable[[jnp.ndarray, str], jnp.ndarray]
 
@@ -68,9 +69,9 @@ def attention_block(
     D = cfg.head_dim
     nq, nkv = cfg.num_attention_heads, cfg.n_kv_heads
 
-    q = jnp.einsum("bsh,hd->bsd", x, p["wq"])
-    k = jnp.einsum("bsh,hd->bsd", x, p["wk"])
-    v = jnp.einsum("bsh,hd->bsd", x, p["wv"])
+    q = jnp.einsum("bsh,hd->bsd", x, deq(p["wq"], x.dtype))
+    k = jnp.einsum("bsh,hd->bsd", x, deq(p["wk"], x.dtype))
+    v = jnp.einsum("bsh,hd->bsd", x, deq(p["wv"], x.dtype))
     if "bq" in p:
         q, k, v = q + p["bq"], k + p["bk"], v + p["bv"]
     q = q.reshape(b, s, nq, D)
@@ -125,18 +126,19 @@ def attention_block(
         impl=cfg.attention_impl,
         softmax_fp32=cfg.softmax_fp32,
     )
-    out = jnp.einsum("bsd,dh->bsh", ctx.reshape(b, s, nq * D), p["wo"])
+    out = jnp.einsum("bsd,dh->bsh", ctx.reshape(b, s, nq * D),
+                     deq(p["wo"], ctx.dtype))
     if "bo" in p:
         out = out + p["bo"]
     return out, kv_cache
 
 
 def mlp_block(cfg: ModelConfig, p: Dict[str, Any], x: jnp.ndarray) -> jnp.ndarray:
-    h = jnp.einsum("bsh,hf->bsf", x, p["w_in"])
+    h = jnp.einsum("bsh,hf->bsf", x, deq(p["w_in"], x.dtype))
     if "b_in" in p:
         h = h + p["b_in"]
     h = apply_activation(cfg.activation, h)
-    out = jnp.einsum("bsf,fh->bsh", h, p["w_out"])
+    out = jnp.einsum("bsf,fh->bsh", h, deq(p["w_out"], h.dtype))
     if "b_out" in p:
         out = out + p["b_out"]
     return out

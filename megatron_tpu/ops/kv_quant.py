@@ -18,14 +18,22 @@ from typing import Tuple
 import jax.numpy as jnp
 
 
+def symmetric_int8(x, axis: int, xp=jnp) -> Tuple["jnp.ndarray", "jnp.ndarray"]:
+    """Symmetric max-abs int8 quantization along `axis` (keepdims scale).
+    The single definition of the 127-level clamp/round recipe — shared by
+    the KV cache (device, xp=jnp) and weight quantization (host, xp=numpy,
+    see ops/weight_quant.py)."""
+    xf = x.astype(xp.float32)
+    amax = xp.max(xp.abs(xf), axis=axis, keepdims=True)
+    scale = xp.maximum(amax, 1e-8) / 127.0
+    q = xp.clip(xp.round(xf / scale), -127, 127).astype(xp.int8)
+    return q, scale
+
+
 def quantize_kv(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """[..., D] float -> (int8 [..., D], fp32 scale [..., 1]); symmetric
     per-vector max-abs scaling."""
-    xf = x.astype(jnp.float32)
-    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
-    scale = jnp.maximum(amax, 1e-8) / 127.0
-    q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
-    return q, scale
+    return symmetric_int8(x, axis=-1)
 
 
 def dequantize_kv(q: jnp.ndarray, scale: jnp.ndarray,

@@ -25,6 +25,10 @@ def extra_args(parser):
     g.add_argument("--kv_cache_int8", action="store_true",
                    help="serve with an int8-quantized KV cache (half the "
                         "cache HBM -> 2x context/batch per chip)")
+    g.add_argument("--weight_int8", action="store_true",
+                   help="int8 weight-only quantization at load: half the "
+                        "param HBM (7B fits one 16GB chip); single-chip "
+                        "serving only")
     return parser
 
 
@@ -58,7 +62,24 @@ def main(argv=None):
     # pipelined forward (ref run_text_generation_server's multi-rank loop)
     mesh = forward_fn = None
     par = cfg.parallel
-    if par.tensor_parallel * par.pipeline_parallel * par.context_parallel > 1:
+    sharded = (par.tensor_parallel * par.pipeline_parallel
+               * par.context_parallel > 1)
+    if args.weight_int8:
+        if sharded:
+            raise SystemExit(
+                "--weight_int8 is single-chip serving only in v1 (the "
+                "quantized {q8, s} leaves change the tree that the sharding "
+                "specs mirror); drop one of the two flags")
+        if cfg.model.num_experts is not None:
+            raise SystemExit(
+                "--weight_int8 does not cover MoE expert weights in v1 — "
+                "the bulk of a MoE model's params would stay bf16 while "
+                "the flag promises halved HBM; serve MoE without it")
+        from megatron_tpu.ops.weight_quant import quantize_params_for_serving
+
+        params = quantize_params_for_serving(params)
+        print("serving int8-quantized weights (matmul + embedding tables)")
+    if sharded:
         from megatron_tpu.inference.pipelined import make_pipelined_lm_forward
         from megatron_tpu.models.params import param_specs
         from megatron_tpu.parallel.mesh import build_mesh
