@@ -46,6 +46,122 @@ def test_bench_main_emits_one_json_line(monkeypatch):
     assert all(("mfu" in s) or s.get("oom") for s in d["sweep"])
 
 
+def _install_fake_clock(monkeypatch, bench):
+    """Patch bench's view of time: perf_counter advances only via sleep."""
+    import time as _time
+
+    state = {"now": _time.perf_counter()}
+    monkeypatch.setattr(bench.time, "perf_counter", lambda: state["now"])
+    monkeypatch.setattr(
+        bench.time, "sleep",
+        lambda s: state.__setitem__("now", state["now"] + s))
+    return state
+
+
+def test_bench_unavailable_emits_parseable_json(monkeypatch):
+    """Tunnel down for the whole budget must still yield one JSON line with
+    an explicit error (the r2 failure mode was rc=1 / parsed=null)."""
+    import bench
+
+    monkeypatch.setenv("MEGATRON_TPU_BENCH_BUDGET_S", "130")
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")  # force the probe path
+    monkeypatch.delenv("MEGATRON_TPU_FORCE_PLATFORM", raising=False)
+    monkeypatch.setattr(bench, "probe_backend",
+                        lambda timeout_s=60.0: (False, "UNAVAILABLE: test"))
+    _install_fake_clock(monkeypatch, bench)
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        bench.main()
+    out = json.loads(buf.getvalue().strip())
+    assert out["error"] == "tpu_unavailable"
+    assert out["metric"] == "llama_train_step_mfu"
+    assert set(out) >= {"metric", "value", "unit", "vs_baseline", "detail"}
+    # the mocked failing probe genuinely ran, and its message propagated
+    assert out["detail"]["probe_attempts"] >= 2
+    assert "UNAVAILABLE: test" in out["detail"]["probe_log"][-1]
+
+
+def test_bench_probe_retries_until_backend_up(monkeypatch):
+    """Probe failures early in the budget must not kill the run — the
+    search should start once a later probe succeeds."""
+    import bench
+    from megatron_tpu.models import presets
+
+    monkeypatch.setenv("MEGATRON_TPU_BENCH_BUDGET_S", "300")
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    monkeypatch.setenv("MEGATRON_TPU_BENCH_EXTRAS", "0")
+    monkeypatch.delenv("MEGATRON_TPU_FORCE_PLATFORM", raising=False)
+    monkeypatch.delenv("MEGATRON_TPU_PROFILE_DIR", raising=False)
+    calls = []
+
+    def flaky_probe(timeout_s=60.0):
+        calls.append(1)
+        return (len(calls) >= 3, "up" if len(calls) >= 3 else "UNAVAILABLE")
+
+    monkeypatch.setattr(bench, "probe_backend", flaky_probe)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.setattr(bench, "headline_config",
+                        lambda seq_length=2048: presets.tiny(
+                            vocab_size=128, seq_length=64, hidden_size=32,
+                            num_layers=2, num_attention_heads=4,
+                            num_kv_heads=2, ffn_hidden_size=64,
+                            params_dtype="float32"))
+    monkeypatch.setattr(bench, "CANDIDATES", (
+        dict(micro_bs=2, granularity="selective", ce_chunk=0),))
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        bench.main()
+    out = json.loads(buf.getvalue().strip())
+    assert "error" not in out and len(calls) == 3
+    assert out["detail"]["micro_bs"] == 2
+
+
+def test_bench_run_wrapper_never_raises(monkeypatch):
+    """run() converts unexpected exceptions into a parseable error line."""
+    import bench
+
+    monkeypatch.setattr(bench, "main",
+                        lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        bench.run()
+    out = json.loads(buf.getvalue().strip())
+    assert "boom" in out["error"]
+
+
+def test_bench_extras_ride_in_detail(monkeypatch):
+    """Forced extras at tiny geometry: largest_trainable reports a fitting
+    config, serving bench reports decode throughput on int8 weights."""
+    import bench
+    from megatron_tpu.models import presets
+
+    tiny = presets.tiny(vocab_size=128, seq_length=64, hidden_size=32,
+                        num_layers=2, num_attention_heads=4, num_kv_heads=2,
+                        ffn_hidden_size=64, params_dtype="float32")
+    monkeypatch.delenv("MEGATRON_TPU_PROFILE_DIR", raising=False)
+    monkeypatch.setenv("MEGATRON_TPU_BENCH_QUICK", "1")
+    monkeypatch.setenv("MEGATRON_TPU_BENCH_EXTRAS", "1")
+    monkeypatch.setenv("MEGATRON_TPU_BENCH_BUDGET_S", "600")
+    monkeypatch.setattr(bench, "headline_config", lambda seq_length=2048: tiny)
+    monkeypatch.setattr(bench, "CANDIDATES", (
+        dict(micro_bs=2, granularity="selective", ce_chunk=0),))
+    monkeypatch.setattr(bench, "largest_candidates", lambda: [tiny])
+    orig = bench.serving_int8_7b_bench
+    monkeypatch.setattr(
+        bench, "serving_int8_7b_bench",
+        lambda deadline: orig(deadline, cfg=tiny, B=2, prompt_len=8,
+                              new_tokens=4))
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        bench.main()
+    out = json.loads(buf.getvalue().strip())
+    lt = out["detail"]["largest_trainable"]
+    assert lt["hidden"] == 32 and lt["mfu"] >= 0
+    sv = out["detail"]["serving_int8_7b"]
+    assert sv["decode_tokens_per_sec"] > 0
+    assert sv["weights"].startswith("int8")
+
+
 def test_bench_quick_mode(monkeypatch):
     import bench
     from megatron_tpu.models import presets
