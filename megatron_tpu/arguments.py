@@ -67,6 +67,9 @@ def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
     g.add_argument("--moe_capacity_factor", type=float, default=None)
     g.add_argument("--moe_aux_loss_coeff", type=float, default=None)
     g.add_argument("--moe_z_loss_coeff", type=float, default=None)
+    g.add_argument("--moe_group_size", type=int, default=None,
+                   help="GShard dispatch group size (tokens); 0 = auto "
+                        "(largest divisor of seq_length <= 2048)")
     g.add_argument("--moe_renorm_gates", action="store_true", default=None)
     g.add_argument("--no_moe_renorm_gates", action="store_false",
                    dest="moe_renorm_gates",
@@ -276,7 +279,7 @@ def _moe_overrides(args) -> dict:
     out = {}
     for name in ("num_experts", "moe_top_k", "moe_capacity_factor",
                  "moe_aux_loss_coeff", "moe_z_loss_coeff",
-                 "moe_renorm_gates"):
+                 "moe_renorm_gates", "moe_group_size"):
         v = getattr(args, name, None)
         if v is not None:
             out[name] = v

@@ -115,6 +115,12 @@ class ModelConfig:
     moe_aux_loss_coeff: float = 1e-2
     moe_z_loss_coeff: float = 0.0
     moe_renorm_gates: bool = True
+    # GShard token-group size for dispatch: capacity is enforced within
+    # fixed-size groups of tokens so the combine/dispatch tensors are
+    # [G, Sg, E, Cg] — linear in total tokens — instead of the global
+    # [N, E, C] quadratic form. 0 = auto (largest divisor of seq_length
+    # <= 2048). Must divide seq_length when set.
+    moe_group_size: int = 0
 
     # regularization
     hidden_dropout: float = 0.0
@@ -207,6 +213,12 @@ class ModelConfig:
                 raise ValueError(
                     f"moe_top_k={self.moe_top_k} must be in "
                     f"[1, num_experts={self.num_experts}]")
+            if self.moe_group_size < 0:
+                raise ValueError("moe_group_size must be >= 0")
+            if self.moe_group_size and self.seq_length % self.moe_group_size:
+                raise ValueError(
+                    f"moe_group_size={self.moe_group_size} must divide "
+                    f"seq_length={self.seq_length}")
         if self.ce_chunk_size < 0:
             raise ValueError("ce_chunk_size must be >= 0")
         if self.ce_chunk_size and self.seq_length % self.ce_chunk_size:

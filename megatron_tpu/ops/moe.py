@@ -13,14 +13,21 @@ Semantics:
     selected gate weights to sum 1 (Mixtral convention — with ample
     capacity this makes the layer numerically equal to HF Mixtral's
     dropless block).
-  * capacity: each expert processes at most C = ceil(capacity_factor *
-    top_k * tokens / E) tokens; overflow tokens lose that expert (their
-    other choices still apply; a token dropped by all choices passes
-    through with zero MLP output, the standard Switch behavior).
+  * grouping (GShard): the N = B*S tokens are reshaped into G groups of
+    Sg tokens (Sg divides S, so groups never cross batch rows and data
+    sharding stays aligned); capacity is enforced *within each group*.
+    The combine/dispatch tensors are [G, Sg, E, Cg] with
+    Cg = ceil(capacity_factor * top_k * Sg / E) — memory and dispatch
+    FLOPs linear in N (the ungrouped global form is O(N^2) in both and
+    costs ~0.7 GB fp32/layer at Mixtral's own seq-8192 geometry).
+  * capacity: each expert processes at most Cg tokens per group;
+    overflow tokens lose that expert (their other choices still apply; a
+    token dropped by all choices passes through with zero MLP output,
+    the standard Switch behavior).
   * auxiliary losses: Switch load-balance loss E * sum_e f_e * P_e over
-    the top-1 assignment fractions f and mean router probabilities P,
-    plus the router z-loss mean(logsumexp(logits)^2) (ST-MoE) for logit
-    drift control.
+    the top-1 assignment fractions f and mean router probabilities P —
+    computed globally over all tokens, not per group — plus the router
+    z-loss mean(logsumexp(logits)^2) (ST-MoE) for logit drift control.
 """
 
 from __future__ import annotations
@@ -42,6 +49,26 @@ def moe_capacity(cfg: ModelConfig, num_tokens: int) -> int:
     E = cfg.num_experts
     c = math.ceil(cfg.moe_capacity_factor * cfg.moe_top_k * num_tokens / E)
     return max(cfg.moe_top_k, c)
+
+
+def _group_for(s: int, target: int) -> int:
+    """Largest divisor of s that is <= target — but never a degenerate
+    sliver: if the best divisor is < 256 (e.g. prime s), whole rows win
+    (tiny groups disable capacity enforcement — with Sg=1 every choice
+    always fits — and shred MXU utilization; whole rows keep semantics at
+    a memory cost)."""
+    if s <= target:
+        return s
+    d = next(g for g in range(target, 0, -1) if s % g == 0)
+    return d if d >= min(256, target) else s
+
+
+def moe_group_size(cfg: ModelConfig) -> int:
+    """Tokens per dispatch group Sg. cfg.moe_group_size, or auto: the
+    largest divisor of seq_length <= 2048 (GShard-scale groups)."""
+    if cfg.moe_group_size:
+        return cfg.moe_group_size
+    return _group_for(cfg.seq_length, 2048)
 
 
 def topk_dispatch(
@@ -85,33 +112,40 @@ def moe_block(
     """Returns (y [B,S,H], aux_loss scalar fp32)."""
     b, s, h = x.shape
     N = b * s
-    xf = x.reshape(N, h)
+    # group tokens GShard-style; Sg must divide the *runtime* S (decode
+    # steps and bucketed prefill call with S != cfg.seq_length) — re-pick
+    # the largest runtime divisor under the configured group size rather
+    # than jumping straight to quadratic whole rows
+    Sg = _group_for(s, moe_group_size(cfg))
+    G = N // Sg
+    xg = x.reshape(G, Sg, h)
 
-    logits = jnp.einsum("nh,he->ne", xf.astype(jnp.float32),
+    logits = jnp.einsum("gsh,he->gse", xg.astype(jnp.float32),
                         p["router"].astype(jnp.float32))
     gates = jax.nn.softmax(logits, axis=-1)
 
-    C = moe_capacity(cfg, N)
-    combine, dispatch, top1 = topk_dispatch(
-        gates, cfg.moe_top_k, C, cfg.moe_renorm_gates)
+    C = moe_capacity(cfg, Sg)
+    combine, dispatch, top1 = jax.vmap(
+        lambda g: topk_dispatch(g, cfg.moe_top_k, C, cfg.moe_renorm_gates)
+    )(gates)                                     # [G, Sg, E, C] / [G, Sg, E]
 
-    # load balance (Switch eq. 4) + router z-loss (ST-MoE)
+    # load balance (Switch eq. 4) + router z-loss (ST-MoE), global over N
     E = cfg.num_experts
-    frac = jnp.mean(top1, axis=0)
-    prob = jnp.mean(gates, axis=0)
+    frac = jnp.mean(top1, axis=(0, 1))
+    prob = jnp.mean(gates, axis=(0, 1))
     lb_loss = E * jnp.sum(frac * prob)
     z_loss = jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2)
     aux = (cfg.moe_aux_loss_coeff * lb_loss
            + cfg.moe_z_loss_coeff * z_loss).astype(jnp.float32)
 
-    # dispatch -> per-expert batches -> combine, all as einsums
-    xe = jnp.einsum("nec,nh->ech", dispatch.astype(x.dtype), xf)
-    hmid = jnp.einsum("ech,ehf->ecf", xe, p["w_in"])
+    # dispatch -> per-(group, expert) batches -> combine, all as einsums
+    xe = jnp.einsum("gsec,gsh->gech", dispatch.astype(x.dtype), xg)
+    hmid = jnp.einsum("gech,ehf->gecf", xe, p["w_in"])
     if "b_in" in p:
-        hmid = hmid + p["b_in"][:, None, :]
+        hmid = hmid + p["b_in"][None, :, None, :]
     hmid = apply_activation(cfg.activation, hmid)
-    out = jnp.einsum("ecf,efh->ech", hmid, p["w_out"])
+    out = jnp.einsum("gecf,efh->gech", hmid, p["w_out"])
     if "b_out" in p:
-        out = out + p["b_out"][:, None, :]
-    y = jnp.einsum("nec,ech->nh", combine.astype(x.dtype), out)
+        out = out + p["b_out"][None, :, None, :]
+    y = jnp.einsum("gsec,gech->gsh", combine.astype(x.dtype), out)
     return y.reshape(b, s, h), aux

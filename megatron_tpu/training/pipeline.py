@@ -96,29 +96,32 @@ def _embed_onehot(cfg: ModelConfig, params: Dict[str, Any],
 def _stage_fn(cfg: ModelConfig, chunk_layers: Any, x: jnp.ndarray,
               rope, positions, dropout_key, global_offset: jnp.ndarray,
               layers_per_chunk: int, recompute: str,
-              sharder=None) -> jnp.ndarray:
+              sharder=None):
     """Run one chunk's contiguous slice of layers (lax.scan over Lv).
     global_offset = index of the chunk's first layer in the full network
-    (for per-layer LIMA dropout rates and dropout key folding)."""
+    (for per-layer LIMA dropout rates and dropout key folding).
+    Returns (x, moe_aux_sum) — aux is a zero scalar for dense models."""
     rates_all = _layer_dropout_rates(cfg)  # [L] per-global-layer rates
 
     def body(carry, scanned):
-        x = carry
+        x, aux = carry
         lp, local_idx = scanned
         global_idx = global_offset + local_idx
         rate = rates_all[global_idx]
         key = (jax.random.fold_in(dropout_key, global_idx)
                if dropout_key is not None else None)
-        y, _, _ = block_forward(cfg, lp, x, rope, positions,
-                                dropout_key=key, hidden_dropout_rate=rate,
-                                **({"sharder": sharder} if sharder else {}))
-        return y, None
+        y, _, moe_aux = block_forward(cfg, lp, x, rope, positions,
+                                      dropout_key=key,
+                                      hidden_dropout_rate=rate,
+                                      **({"sharder": sharder} if sharder else {}))
+        return (y, aux + moe_aux), None
 
     policy = _remat_policy(recompute)
     if policy is not None:
         body = jax.checkpoint(body, policy=policy, prevent_cse=False)
-    x, _ = jax.lax.scan(body, x, (chunk_layers, jnp.arange(layers_per_chunk)))
-    return x
+    (x, aux), _ = jax.lax.scan(body, (x, jnp.zeros((), jnp.float32)),
+                               (chunk_layers, jnp.arange(layers_per_chunk)))
+    return x, aux
 
 
 def vpp_place_indices(L: int, Pn: int, V: int):
@@ -160,6 +163,7 @@ def make_pipeline_loss_fn(
     num_virtual_chunks: int = 1,
     remat_segment: Optional[int] = None,
     layers_placed: bool = False,
+    gate_bubbles: Optional[bool] = None,
 ):
     """Returns loss_fn(params, batch, dropout_key) -> (mean_loss, aux).
 
@@ -172,14 +176,13 @@ def make_pipeline_loss_fn(
     ticks (num_stages is the natural choice), bounding backward-pass live
     carries to ~(T/seg + seg) instead of one per tick; costs one extra
     forward replay per segment.
+
+    gate_bubbles: skip the layer scan on bubble ticks (None = auto: on for
+    meshes where the stage body has no cross-stage-divergent collectives —
+    see the deadlock note at the auto rule below).
     """
     Pn, M, V = num_stages, num_microbatches, num_virtual_chunks
     seg = remat_segment
-    if model_cfg.num_experts is not None:
-        raise NotImplementedError(
-            "MoE + pipeline parallelism is not wired yet (the router aux "
-            "loss needs accumulation across stages) — use dp/tp/ep for "
-            "MoE models")
     L = model_cfg.num_layers
     if L % (Pn * V):
         raise ValueError(
@@ -193,6 +196,25 @@ def make_pipeline_loss_fn(
             f"(got {M} % {Pn}; ref schedules.py:22-29)")
 
     place, _ = vpp_place_indices(L, Pn, V)
+
+    # Bubble-tick gating: stages skip the layer scan on invalid ticks
+    # (saves the garbage compute the ungated schedule pays, ~(Pn-1)/T of
+    # all stage executions). Only safe when the stage body contains no
+    # GSPMD collectives whose replica groups can span pipe ranks: with
+    # tensor/context sharding — or a sharder resharding activations over
+    # a >1 data axis — the partitioner emits global-group
+    # collective-permutes inside the cond branch and bubble stages never
+    # arrive: a hard deadlock (observed on XLA:CPU at pp2 x tp2, and at
+    # pp2 x dp4 with the data-resharding constraint; hoisting the
+    # constraint out of the cond does not help — the partitioner still
+    # places divergent reshards inside the branch). Safe cases: pure-pp
+    # meshes (data=tensor=context=1, the constraint is a no-op) and
+    # sharder-free callers (activations replicated, compute uniform).
+    if gate_bubbles is None:
+        axes = dict(getattr(mesh, "shape", {}))
+        gate_bubbles = (axes.get("tensor", 1) == 1
+                        and axes.get("context", 1) == 1
+                        and (axes.get("data", 1) == 1 or sharder is None))
 
     def loss_fn(params: Dict[str, Any], batch: Dict[str, jnp.ndarray],
                 dropout_key: Optional[jax.Array] = None):
@@ -253,7 +275,7 @@ def make_pipeline_loss_fn(
             perm = [(i, (i + 1) % Pn) for i in range(Pn)]
 
             def tick(carry, t):
-                state, loss_sum, tok_sum = carry
+                state, loss_sum, tok_sum, aux_sum = carry
                 n = jnp.clip(t - stage, 0, M * V - 1)  # this stage's step
                 valid = (t >= stage) & (t - stage < M * V)
                 g = n // (Pn * V)
@@ -285,9 +307,24 @@ def make_pipeline_loss_fn(
                     params_local["layers"])
                 global_offset = (c * Pn + stage) * Lv
                 key_t = (jax.random.fold_in(key, m) if dropout_on else None)
-                out = _stage_fn(model_cfg, chunk_layers, x, rope,
-                                pos_m, key_t, global_offset, Lv, recompute,
-                                sharder=sharder)
+
+                # Bubble ticks skip the layer scan entirely when the mesh
+                # allows it (see gate_bubbles above; the reference's
+                # schedule simply doesn't issue work there). The ppermute
+                # below stays unconditional either way — the known deadlock
+                # class is collectives whose participants diverge.
+                def run_stage(x):
+                    return _stage_fn(model_cfg, chunk_layers, x, rope,
+                                     pos_m, key_t, global_offset, Lv,
+                                     recompute, sharder=sharder)
+
+                if gate_bubbles:
+                    out, stage_aux = jax.lax.cond(
+                        valid, run_stage,
+                        lambda x: (x, jnp.zeros((), jnp.float32)), x)
+                else:
+                    out, stage_aux = run_stage(x)
+                    stage_aux = jnp.where(valid, stage_aux, 0.0)
 
                 def with_loss(_):
                     h = final_hidden_norm(model_cfg, params_local, out)
@@ -312,15 +349,17 @@ def make_pipeline_loss_fn(
                     operand=None)
 
                 state = jax.lax.ppermute(out, "pipe", perm)
-                return (state, loss_sum + lsum, tok_sum + lcnt), None
+                return (state, loss_sum + lsum, tok_sum + lcnt,
+                        aux_sum + stage_aux), None
 
             h0 = jnp.zeros(
                 (mbs, S, model_cfg.hidden_size),
                 model_cfg.dtype,
             )
-            carry0 = (h0, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
+            carry0 = (h0, jnp.zeros((), jnp.float32),
+                      jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
             if seg is None:
-                (state, loss_sum, tok_sum), _ = jax.lax.scan(
+                (state, loss_sum, tok_sum, aux_sum), _ = jax.lax.scan(
                     tick, carry0, jnp.arange(T))
             else:
                 # Segmented remat over the tick scan: without it, autodiff
@@ -349,11 +388,16 @@ def make_pipeline_loss_fn(
                     return jax.lax.scan(masked_tick, carry, tick_ids)
 
                 segment = jax.checkpoint(segment, prevent_cse=False)
-                (state, loss_sum, tok_sum), _ = jax.lax.scan(
+                (state, loss_sum, tok_sum, aux_sum), _ = jax.lax.scan(
                     segment, carry0, ticks)
             loss_sum = jax.lax.psum(loss_sum, "pipe")
             tok_sum = jax.lax.psum(tok_sum, "pipe")
-            return loss_sum / jnp.maximum(tok_sum, 1.0), tok_sum
+            # router aux summed over every (stage, chunk, microbatch) tick =
+            # sum over all layers per microbatch; /M matches the
+            # per-microbatch-averaged unpipelined loss (ref: schedules.py
+            # loss averaging + gpt_model.py:18 last-stage loss assembly)
+            aux_sum = jax.lax.psum(aux_sum, "pipe") / M
+            return (loss_sum / jnp.maximum(tok_sum, 1.0), tok_sum, aux_sum)
 
         other = {k: v for k, v in params.items() if k != "layers"}
         in_specs = (
@@ -365,12 +409,16 @@ def make_pipeline_loss_fn(
             pipelined,
             mesh=mesh,
             in_specs=in_specs,
-            out_specs=(P(), P()),
+            out_specs=(P(), P(), P()),
             axis_names={"pipe"},
             check_vma=False,
         )
-        mean_loss, ntokens = fn(layers, other, tokens, position_ids,
-                                labels, loss_mask, key_arg)
-        return mean_loss, {"lm_loss": mean_loss, "ntokens": ntokens}
+        mean_loss, ntokens, moe_aux = fn(layers, other, tokens, position_ids,
+                                         labels, loss_mask, key_arg)
+        aux = {"lm_loss": mean_loss, "ntokens": ntokens}
+        if model_cfg.num_experts is not None:
+            aux["moe_aux_loss"] = moe_aux
+            return mean_loss + moe_aux, aux
+        return mean_loss, aux
 
     return loss_fn

@@ -11,7 +11,9 @@ from megatron_tpu.config import ParallelConfig
 from megatron_tpu.models import presets
 from megatron_tpu.models.language_model import lm_loss
 from megatron_tpu.models.params import init_params, param_specs
-from megatron_tpu.ops.moe import moe_block, moe_capacity, topk_dispatch
+from megatron_tpu.ops.moe import (
+    moe_block, moe_capacity, moe_group_size, topk_dispatch,
+)
 
 
 def _moe_cfg(**kw):
@@ -217,14 +219,133 @@ def test_moe_experts_must_divide_dp():
         TrainLoop(cfg, log=lambda s: None)
 
 
-def test_moe_pipeline_not_supported():
+def test_moe_pipeline_matches_unpipelined():
+    """pp2 x MoE: pipelined loss (CE + router aux accumulated across
+    stages into the last-stage total) equals the per-microbatch-averaged
+    unpipelined MoE loss. The aux term is batch-composition-dependent
+    (frac*prob is nonlinear in the token set), so the honest reference is
+    the microbatched unpipelined path, not one full-batch forward."""
     from megatron_tpu.parallel.mesh import build_mesh
     from megatron_tpu.training.pipeline import make_pipeline_loss_fn
 
     cfg = _moe_cfg()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    M, mbs = 2, 2
+    batch = {
+        "tokens": jnp.asarray(rng.integers(0, 96, (M * mbs, 16)), jnp.int32),
+        "labels": jnp.asarray(rng.integers(0, 96, (M * mbs, 16)), jnp.int32),
+        "loss_mask": jnp.ones((M * mbs, 16), jnp.float32),
+    }
+    per_mb = []
+    for m in range(M):
+        mb = {k: v[m * mbs:(m + 1) * mbs] for k, v in batch.items()}
+        per_mb.append(float(lm_loss(cfg, params, mb)[0]))
+    ref = float(np.mean(per_mb))
+
     rt = build_mesh(ParallelConfig(pipeline_parallel=2))
-    with pytest.raises(NotImplementedError, match="MoE"):
-        make_pipeline_loss_fn(cfg, rt.mesh, 2, 2)
+    loss_fn = make_pipeline_loss_fn(cfg, rt.mesh, 2, M)
+    with jax.sharding.set_mesh(rt.mesh):
+        loss, aux = jax.jit(loss_fn)(params, batch)
+    assert float(loss) == pytest.approx(ref, rel=1e-5)
+    assert float(aux["moe_aux_loss"]) > 0
+    # gradients flow to the router through the pipelined path
+    with jax.sharding.set_mesh(rt.mesh):
+        g = jax.jit(jax.grad(lambda p, b: loss_fn(p, b)[0]))(params, batch)
+    assert float(jnp.abs(g["layers"]["moe"]["router"]).sum()) > 0
+
+
+def test_moe_group_size_rule():
+    from megatron_tpu.ops.moe import _group_for
+
+    # auto: largest divisor of seq_length <= 2048
+    assert moe_group_size(_moe_cfg(seq_length=16)) == 16
+    assert moe_group_size(_moe_cfg(seq_length=8192)) == 2048
+    assert moe_group_size(_moe_cfg(seq_length=3000)) == 1500
+    # explicit wins; must divide seq_length
+    assert moe_group_size(_moe_cfg(seq_length=16, moe_group_size=8)) == 8
+    with pytest.raises(ValueError, match="moe_group_size"):
+        _moe_cfg(seq_length=16, moe_group_size=6)
+    # degenerate divisors (prime lengths) fall back to whole rows instead
+    # of Sg=1 slivers that would disable capacity enforcement
+    assert moe_group_size(_moe_cfg(seq_length=2053)) == 2053
+    # runtime re-pick: a 2500-token prefill bucket under a 2048 group
+    # config uses 1250-token groups, not quadratic whole rows
+    assert _group_for(2500, 2048) == 1250
+
+
+def test_moe_grouped_matches_whole_batch_with_ample_capacity():
+    """With dropless capacity the grouping is invisible: Sg=4 groups give
+    the same output as whole-row groups."""
+    cfg_small = _moe_cfg(moe_capacity_factor=4.0, moe_group_size=4)
+    cfg_row = _moe_cfg(moe_capacity_factor=4.0, moe_group_size=16)
+    params = init_params(cfg_small, jax.random.PRNGKey(0))
+    p = jax.tree.map(lambda l: l[0], params["layers"]["moe"])  # layer 0
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(0, 1, (2, 16, 32)), jnp.float32)
+    y_small, aux_small = moe_block(cfg_small, p, x)
+    y_row, aux_row = moe_block(cfg_row, p, x)
+    np.testing.assert_allclose(np.asarray(y_small), np.asarray(y_row),
+                               rtol=1e-5, atol=1e-6)
+    # aux losses are global over tokens, so they match too
+    assert float(aux_small) == pytest.approx(float(aux_row), rel=1e-6)
+
+
+def test_moe_capacity_is_per_group():
+    """Overflow in one group must not consume another group's slots — and
+    a group's own overflow still drops (tight capacity)."""
+    cfg = _moe_cfg(num_experts=2, moe_top_k=1, moe_capacity_factor=0.51,
+                   moe_group_size=4, seq_length=8, hidden_size=4,
+                   vocab_size=32, num_attention_heads=2, num_kv_heads=1)
+    # router that sends every token to expert 0
+    p = {
+        "router": jnp.asarray([[5.0, -5.0]] * 4, jnp.float32).reshape(4, 2),
+        "w_in": jnp.ones((2, 4, 2 * cfg.ffn_size), jnp.float32) * 0.1,
+        "w_out": jnp.ones((2, cfg.ffn_size, 4), jnp.float32) * 0.1,
+    }
+    x = jnp.ones((1, 8, 4), jnp.float32)
+    y, _ = moe_block(cfg, p, x)
+    y = np.asarray(y)[0]  # [8, 4]
+    # capacity per group of 4 = ceil(0.51*1*4/2)=2: in EACH group the first
+    # two tokens are kept, the last two dropped (zero output). Global
+    # capacity would have dropped tokens 4..7 entirely.
+    kept = np.abs(y).sum(axis=1) > 0
+    np.testing.assert_array_equal(kept, [True, True, False, False,
+                                         True, True, False, False])
+
+
+def test_moe_mixtral_geometry_compiles_within_memory():
+    """The VERDICT r2 gate: a full Mixtral-8x7B-geometry MoE layer
+    (H=4096, F=14336, E=8, top-2) at seq 8192 must fit on a 16 GB chip.
+    Executing 6e15 FLOPs on CPU is infeasible, so this compiles the
+    jitted fwd+bwd on the CPU backend and asserts XLA's own temp-buffer
+    accounting stays within budget — the grouped dispatch is what makes
+    this pass (the global [N,E,C] form needs ~0.7 GB fp32 per combine
+    tensor plus matching gradients)."""
+    cfg = _moe_cfg(num_experts=8, moe_top_k=2, moe_capacity_factor=1.25,
+                   hidden_size=4096, ffn_hidden_size=14336, seq_length=8192,
+                   vocab_size=32000, num_attention_heads=32, num_kv_heads=8,
+                   params_dtype="bfloat16")
+    assert moe_group_size(cfg) == 2048
+
+    def layer_loss(p, x):
+        y, aux = moe_block(cfg, p, x)
+        return jnp.sum(y.astype(jnp.float32) ** 2) + aux
+
+    p_shapes = {
+        "router": jax.ShapeDtypeStruct((4096, 8), jnp.bfloat16),
+        "w_in": jax.ShapeDtypeStruct((8, 4096, 2 * 14336), jnp.bfloat16),
+        "w_out": jax.ShapeDtypeStruct((8, 14336, 4096), jnp.bfloat16),
+    }
+    x_shape = jax.ShapeDtypeStruct((1, 8192, 4096), jnp.bfloat16)
+    lowered = jax.jit(jax.grad(layer_loss)).lower(p_shapes, x_shape)
+    mem = lowered.compile().memory_analysis()
+    temp_gb = mem.temp_size_in_bytes / 2**30
+    arg_gb = mem.argument_size_in_bytes / 2**30
+    # weights are ~1.9 GB bf16 + grads; temps must leave room on 16 GB
+    # (measured 7.2 GB: hmid [G,E,Cg,2F] and its cotangent dominate)
+    assert temp_gb < 8.0, f"temp {temp_gb:.2f} GB"
+    assert arg_gb + temp_gb < 12.0, f"total {arg_gb + temp_gb:.2f} GB"
 
 
 def test_moe_capacity_formula():

@@ -81,6 +81,76 @@ def test_pipeline_train_step_descends():
     assert last < first * 0.7, (first, last)
 
 
+def test_pipeline_bubble_gate_saves_walltime():
+    """Quantify the schedule taxes (VERDICT r2 weak #4): measure jitted
+    fwd+bwd wall-clock for (a) unpipelined, (b) pp2 gated, (c) pp2
+    ungated, at a fixed global batch on the CPU mesh. Asserts the gate
+    never *hurts* materially; prints the measured ratios so STATUS can
+    report pipeline overhead from a reproducible source.
+
+    With pp=2, M=4, V=1: T = 5 ticks, 2 stages -> 10 stage-slots, 8
+    valid -> the ungated path wastes 20% of stage compute; the gated path
+    should recover most of it (cond overhead and XLA scheduling eat some).
+    """
+    import time
+
+    cfg, rt, params, batch = _setup(2, num_layers=4, n_micro=4, mbs=2,
+                                    seq=64, vocab=128)
+
+    def timed(fn, *args):
+        fn(*args)  # compile + warmup
+        t0 = time.perf_counter()
+        for _ in range(8):
+            out = fn(*args)
+        jax.tree.map(lambda a: a.block_until_ready(), out)
+        return (time.perf_counter() - t0) / 8
+
+    grad_ref = jax.jit(jax.grad(lambda p, b: lm_loss(cfg, p, b)[0]))
+    t_ref = timed(grad_ref, jax.device_get(params), jax.device_get(batch))
+
+    results = {}
+    for label, gate in (("gated", True), ("ungated", False)):
+        loss_fn = make_pipeline_loss_fn(cfg, rt.mesh, num_stages=2,
+                                        num_microbatches=4, recompute="full",
+                                        gate_bubbles=gate)
+        with jax.sharding.set_mesh(rt.mesh):
+            g = jax.jit(jax.grad(lambda p, b: loss_fn(p, b)[0]))
+            results[label] = timed(g, params, batch)
+    print(f"\npipeline overhead: pp1 {t_ref*1e3:.1f} ms, "
+          f"pp2 gated {results['gated']*1e3:.1f} ms, "
+          f"pp2 ungated {results['ungated']*1e3:.1f} ms, "
+          f"gated/ungated {results['gated']/results['ungated']:.3f}, "
+          f"pp2(gated)/pp1 {results['gated']/t_ref:.3f}")
+    # CPU timing is noisy on shared runners; the hard claim is only
+    # "gating never costs materially more than not gating"
+    assert results["gated"] < results["ungated"] * 1.3, results
+
+
+def test_pipeline_gated_pure_pp_with_production_sharder():
+    """The TrainLoop wiring: pure-pp mesh + the residual-constraining
+    sharder must auto-gate bubbles and still match the unpipelined loss.
+    (With data/tensor/context sharding the gate must stay OFF: GSPMD puts
+    global-group resharding collective-permutes inside the stage cond and
+    bubble stages never join — a hard deadlock, observed on XLA:CPU at
+    pp2 x tp2 and pp2 x dp4.)"""
+    from megatron_tpu.parallel.sharding import activation_spec, constrain
+
+    cfg, rt, params, batch = _setup(8, num_layers=8, n_micro=8, mbs=1)
+
+    def sharder(x, role):
+        if role == "residual":
+            return constrain(x, activation_spec(False))
+        return x
+
+    loss_fn = make_pipeline_loss_fn(cfg, rt.mesh, num_stages=8,
+                                    num_microbatches=8, recompute="full",
+                                    sharder=sharder, remat_segment=8)
+    with jax.sharding.set_mesh(rt.mesh):
+        loss_pp, _ = jax.jit(lambda p, b: loss_fn(p, b, None))(params, batch)
+    loss_ref = lm_loss(cfg, jax.device_get(params), jax.device_get(batch))[0]
+    np.testing.assert_allclose(float(loss_pp), float(loss_ref), rtol=1e-5)
+
+
 def test_pipeline_rejects_indivisible_layers():
     cfg, rt, params, batch = _setup(2, num_layers=4)
     with pytest.raises(ValueError):
