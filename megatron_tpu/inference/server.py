@@ -38,6 +38,13 @@ class GenerationService:
         """mesh + forward_fn serve sharded models: the mesh becomes
         ambient around generation (GSPMD handles tp/cp), forward_fn is the
         pp>1 pipelined forward (ref ForwardStep, forward_step.py:45-204)."""
+        if kv_cache_int8 and forward_fn is not None:
+            # fail at construction, not as a 500 on every request — the
+            # pipelined forward threads bf16 cache pairs (the same guard
+            # generate_tokens applies per call)
+            raise ValueError(
+                "kv_cache_int8 is not supported with a pipelined (pp>1) "
+                "forward_fn — serve pp>1 models with bf16 KV caches")
         self.cfg = cfg
         self.params = params
         self.tokenizer = tokenizer

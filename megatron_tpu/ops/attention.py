@@ -77,13 +77,12 @@ def attention(
             if impl == "ulysses":
                 from megatron_tpu.ops.ulysses import ulysses_attention_sharded
 
-                # inner attention runs full-sequence per head shard: use the
-                # flash kernel on TPU or per-device score memory is O(S^2) —
-                # the thing context parallelism was chosen to avoid
-                inner = "pallas" if jax.default_backend() != "cpu" else "xla"
+                # inner_impl None = auto: the flash kernel on TPU (per-device
+                # score memory would otherwise be O(S^2) — the thing context
+                # parallelism was chosen to avoid), fused XLA on CPU
                 return ulysses_attention_sharded(
                     q, k, v, mesh=None, mask_type=mask_type,
-                    sliding_window=sliding_window, inner_impl=inner)
+                    sliding_window=sliding_window)
             from megatron_tpu.ops.ring_attention import ring_attention_sharded
 
             return ring_attention_sharded(

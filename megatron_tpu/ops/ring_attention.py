@@ -69,8 +69,10 @@ def ring_attention_zigzag(
     k: jnp.ndarray,
     v: jnp.ndarray,
     axis_name: str = AXIS_CONTEXT,
+    sliding_window: Optional[int] = None,
 ) -> jnp.ndarray:
-    """Causal ring attention on zig-zag-striped sequences.
+    """Causal (optionally sliding-window) ring attention on
+    zig-zag-striped sequences.
 
     Local layout: first half = stripe `my`, second half = stripe
     `2cp-1-my`. Per ring step with the block from rank `src`, only three
@@ -80,6 +82,10 @@ def ring_attention_zigzag(
       q_hi x k_hi   iff src >= my
     so two of the three einsums sit behind lax.cond — every rank runs
     2cp+1 stripe-einsums per full ring regardless of its rank index.
+
+    A sliding window tightens each predicate further (stripes entirely
+    before qp_min - window contribute nothing), so narrow windows skip
+    most of the ring; the per-rank stripe pairing keeps cost uniform.
     """
     b, sq, hq, d = q.shape
     assert k.shape[1] == sq, "zigzag path assumes equal local q/kv lengths"
@@ -88,6 +94,7 @@ def ring_attention_zigzag(
     cp = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     c = sq // 2
+    w = sliding_window
 
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
     qg = (q.astype(jnp.float32) * scale).reshape(b, sq, hkv, groups, d)
@@ -97,11 +104,24 @@ def ring_attention_zigzag(
     neg = jnp.float32(-jnp.inf)
 
     def causal_bias(qp, kp):
-        return jnp.where(kp[None, :] <= qp[:, None], 0.0, neg)
+        allowed = kp[None, :] <= qp[:, None]
+        if w is not None:
+            allowed &= kp[None, :] > qp[:, None] - w
+        return jnp.where(allowed, 0.0, neg)
+
+    def in_window(k_stripe, q_stripe):
+        """Stripe-level window reachability: stripe indices are traced
+        ints; kp_max = (k_stripe+1)*c - 1, qp_min = q_stripe*c."""
+        if w is None:
+            return True
+        return (k_stripe + 1) * c - 1 > q_stripe * c - w
 
     perm = [(i, (i + 1) % cp) for i in range(cp)]
 
     def guarded(pred, qs, ks, vs, bias, m, l, acc):
+        if pred is True:  # statically unconditional (w=None fast path)
+            return _block_attention_step(qs, ks, vs, bias, m, l, acc)
+
         def do(args):
             m, l, acc = args
             return _block_attention_step(qs, ks, vs, bias, m, l, acc)
@@ -111,17 +131,18 @@ def ring_attention_zigzag(
     def step(carry, r):
         kc, vc, st_lo, st_hi = carry
         src = (my - r) % cp
+        my_hi, src_hi = 2 * cp - 1 - my, 2 * cp - 1 - src
         kp_lo, kp_hi = _zigzag_positions(c, src, cp)
         k_lo = kc[:, :c].astype(jnp.float32)
         k_hi = kc[:, c:].astype(jnp.float32)
         v_lo, v_hi = vc[:, :c], vc[:, c:]
 
-        st_lo = guarded(src <= my, q_lo, k_lo, v_lo,
-                        causal_bias(qp_lo, kp_lo), *st_lo)
-        st_hi = _block_attention_step(q_hi, k_lo, v_lo,
-                                      causal_bias(qp_hi, kp_lo), *st_hi)
-        st_hi = guarded(src >= my, q_hi, k_hi, v_hi,
-                        causal_bias(qp_hi, kp_hi), *st_hi)
+        st_lo = guarded((src <= my) & in_window(src, my),
+                        q_lo, k_lo, v_lo, causal_bias(qp_lo, kp_lo), *st_lo)
+        st_hi = guarded(in_window(src, my_hi),
+                        q_hi, k_lo, v_lo, causal_bias(qp_hi, kp_lo), *st_hi)
+        st_hi = guarded((src >= my) & in_window(src_hi, my_hi),
+                        q_hi, k_hi, v_hi, causal_bias(qp_hi, kp_hi), *st_hi)
 
         kc = jax.lax.ppermute(kc, axis_name, perm)
         vc = jax.lax.ppermute(vc, axis_name, perm)
@@ -231,12 +252,13 @@ def ring_attention_sharded(
 ) -> jnp.ndarray:
     """GSPMD-callable wrapper: context axis manual, everything else auto.
 
-    mesh=None uses the ambient mesh (jax.sharding.set_mesh). Plain causal
-    uses the zig-zag balanced path (the seq-axis permutation outside the
-    manual region costs O(S*H*D) resharding against the O(S^2) attention
-    it halves; keeping the whole residual stream in zig-zag order would
-    amortize even that, at the cost of position-dependent ops everywhere —
-    deliberately not done)."""
+    mesh=None uses the ambient mesh (jax.sharding.set_mesh). Causal —
+    plain or sliding-window — uses the zig-zag balanced path (the
+    seq-axis permutation outside the manual region costs O(S*H*D)
+    resharding against the O(S^2) attention it halves; keeping the whole
+    residual stream in zig-zag order would amortize even that, at the
+    cost of position-dependent ops everywhere — deliberately not done).
+    The contiguous path remains for non-causal masks and odd lengths."""
     use_mesh = mesh
     if use_mesh is None:
         from jax.sharding import get_abstract_mesh
@@ -244,11 +266,11 @@ def ring_attention_sharded(
         use_mesh = get_abstract_mesh()
     cp = use_mesh.shape.get(AXIS_CONTEXT, 1) if use_mesh is not None else 1
     S = q.shape[1]
-    if (mask_type == "causal" and sliding_window is None and cp > 1
-            and S % (2 * cp) == 0):
+    if mask_type == "causal" and cp > 1 and S % (2 * cp) == 0:
         perm, inv = _zigzag_perm(S, cp)
         fn = jax.shard_map(
-            lambda q, k, v: ring_attention_zigzag(q, k, v),
+            lambda q, k, v: ring_attention_zigzag(
+                q, k, v, sliding_window=sliding_window),
             mesh=mesh,
             in_specs=(P(None, AXIS_CONTEXT), P(None, AXIS_CONTEXT),
                       P(None, AXIS_CONTEXT)),

@@ -79,10 +79,23 @@ def save_checkpoint(
     return path
 
 
+def _template_sharding(x):
+    """Explicit restore target for a template leaf: its own placement if it
+    is a live array, else this process's default device. Never None —
+    orbax's sharding-from-file fallback is both slower and unsafe when
+    restoring on a different topology than the save."""
+    s = getattr(x, "sharding", None)
+    if s is None:
+        s = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+    return s
+
+
 def _abstract_like(state: TrainState, shardings=None) -> TrainState:
     if shardings is None:
         return jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state)
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                           sharding=_template_sharding(x)),
+            state)
     return jax.tree.map(
         lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
         state, shardings)
@@ -133,7 +146,8 @@ def load_checkpoint(
                 state_template.params, shardings.params)
         else:
             fake_master = jax.tree.map(
-                lambda x: jax.ShapeDtypeStruct(x.shape, jnp.float32),
+                lambda x: jax.ShapeDtypeStruct(
+                    x.shape, jnp.float32, sharding=_template_sharding(x)),
                 state_template.params)
         abstract = dataclasses.replace(abstract, master=fake_master)
         restored = ckptr.restore(os.path.join(path, "state"), abstract)
@@ -183,21 +197,31 @@ def load_params_only(
                 lambda x, s: jax.ShapeDtypeStruct(x.shape, dtype or x.dtype,
                                                   sharding=s), tree, shards)
         return jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, dtype or x.dtype), tree)
+            lambda x: jax.ShapeDtypeStruct(x.shape, dtype or x.dtype,
+                                           sharding=_template_sharding(x)),
+            tree)
 
     ckptr = ocp.PyTreeCheckpointer()
+
+    def restore(target):
+        # PyTreeRestore ignores ShapeDtypeStruct.sharding unless it is
+        # also threaded through restore_args — without it orbax falls
+        # back to sharding-from-file (slow, unsafe across topologies)
+        return ckptr.restore(
+            path, args=ocp.args.PyTreeRestore(
+                item=target,
+                restore_args=ocp.checkpoint_utils.construct_restore_args(
+                    target),
+                partial_restore=True))
+
     try:
         # prefer the fp32 master copies when the checkpoint has them
         target = {"master": abstract(params_template, dtype=jnp.float32,
                                      shards=shardings)}
-        restored = ckptr.restore(
-            path, args=ocp.args.PyTreeRestore(
-                item=target, partial_restore=True))["master"]
+        restored = restore(target)["master"]
     except Exception:
         target = {"params": abstract(params_template, shards=shardings)}
-        restored = ckptr.restore(
-            path, args=ocp.args.PyTreeRestore(
-                item=target, partial_restore=True))["params"]
+        restored = restore(target)["params"]
     # stored dtype may differ from the serving dtype (e.g. bf16 checkpoint
     # served fp32, or master fp32 served bf16) — land on the template's
     return jax.tree.map(lambda r, p: r.astype(p.dtype),

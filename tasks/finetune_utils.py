@@ -33,6 +33,11 @@ def _epoch_iter(ds, consumed: int, gbs: int, seed: int):
         e, o = divmod(pos, n)
         if e not in orders:
             orders[e] = np.random.RandomState(seed + e).permutation(n)
+        if hasattr(ds, "set_epoch"):
+            # datasets with per-item randomness (e.g. ORQA negative
+            # sampling) fold the epoch into their seed so multi-epoch
+            # runs see fresh draws, deterministically
+            ds.set_epoch(e)
         return ds[int(orders[e][o])]
 
     pos = consumed

@@ -366,13 +366,17 @@ def prepare_input_for_response_generation(test_file: str, knwl_gen_file: str,
     (ref preprocessing.py:533-559)."""
     with open(knwl_gen_file, encoding="utf-8") as f:
         knowledge_list = f.readlines()
+    with open(test_file, encoding="utf-8") as f:
+        rows = [l for l in (line.strip() for line in f) if l]
+    if len(knowledge_list) < len(rows):
+        raise ValueError(
+            f"{knwl_gen_file} has {len(knowledge_list)} lines but "
+            f"{test_file} has {len(rows)} non-blank rows — a truncated "
+            "knowledge-generation output would desynchronize the "
+            "substitution")
     n = 0
-    with open(test_file, encoding="utf-8") as fr, \
-            open(processed_file, "w", encoding="utf-8") as fw:
-        for line in fr:
-            line = line.strip()
-            if not line:
-                continue
+    with open(processed_file, "w", encoding="utf-8") as fw:
+        for line in rows:
             splits = line.split("\t")
             # index by written row, not raw line number: blank lines in the
             # tsv must not desynchronize the knowledge alignment

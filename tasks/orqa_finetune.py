@@ -71,9 +71,10 @@ class NQSupervisedDataset:
     """Tokenized DPR samples with a STATIC number of negatives per item.
 
     train mode (evaluate=False): `num_neg` hard negatives, topped up with
-    simple negatives then all-pad rows; shuffled per (seed, epoch-free
-    idx) so runs are deterministic (ref data.py:188-207 shuffles with the
-    global RNG instead).
+    simple negatives then all-pad rows; shuffled per (seed, idx, epoch)
+    so runs are deterministic yet multi-epoch runs see fresh negative
+    draws (ref data.py:188-207 shuffles with the global RNG — varied but
+    not resumable; set_epoch is fed by the finetune sample stream).
     eval mode: first `val_other_neg` simple + `val_hard_neg` hard
     negatives, unshuffled (ref data.py:181-187).
     """
@@ -91,6 +92,10 @@ class NQSupervisedDataset:
         self.num_neg = (val_hard_neg + val_other_neg) if evaluate else num_neg
         self.val_hard_neg, self.val_other_neg = val_hard_neg, val_other_neg
         self.seed = seed
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = int(epoch)
 
     def __len__(self) -> int:
         return len(self.samples)
@@ -114,7 +119,8 @@ class NQSupervisedDataset:
                 negs = (s["negative_context"][: self.val_other_neg]
                         + s["hard_negative_context"][: self.val_hard_neg])
             else:
-                rng = np.random.RandomState((self.seed + idx) & 0x7FFFFFFF)
+                rng = np.random.RandomState(
+                    (self.seed + idx + 1000003 * self.epoch) & 0x7FFFFFFF)
                 hard = list(s["hard_negative_context"])
                 simple = list(s["negative_context"])
                 rng.shuffle(hard)
@@ -233,7 +239,10 @@ def orqa_eval(loop, valid_ds, batch: int = 8, score_scaling: bool = False,
                                       jnp.asarray(col_real)))
             ranks.extend(int(r) for r in vec[:n_real])
     arr = np.asarray(ranks, np.float64)
-    out = {"rank": float(arr.mean() + 1.0)}  # ref reports 1-based mean rank
+    # mean of 0-based ranks, matching the reference's get_rank (which sums
+    # 0-based torch.nonzero positions); topk accuracies are fractions, not
+    # the reference's percents
+    out = {"rank": float(arr.mean())}
     for k in topk:
         out[f"top{k}_acc"] = float((arr < k).mean())
     return out
