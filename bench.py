@@ -362,6 +362,19 @@ def main():
 
     import jax
 
+    # Persistent compilation cache: a retry after a tunnel flap (or the
+    # driver's end-of-round run) skips the multi-minute compile, so a short
+    # tunnel window suffices for a number (VERDICT r3 next-round #1).
+    cache_dir = os.environ.get(
+        "MEGATRON_TPU_JAX_CACHE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"))
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:  # noqa: BLE001 - cache is best-effort
+        print(f"# compilation cache unavailable: {e}", file=sys.stderr)
+
     from megatron_tpu.models.params import num_params
     from megatron_tpu.platform import peak_bf16_flops
 

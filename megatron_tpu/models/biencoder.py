@@ -155,5 +155,7 @@ def biencoder_loss(
         (scores > jnp.take_along_axis(scores, labels[:, None], axis=1)),
         axis=1)
     for k in topk:
-        aux[f"top{k}_acc"] = jnp.mean((ranks < k).astype(jnp.float32))
+        # percent, the reference's reporting convention
+        # (ref pretrain_ict.py:114 topk_acc_dict v * 100)
+        aux[f"top{k}_acc"] = 100.0 * jnp.mean((ranks < k).astype(jnp.float32))
     return loss, aux

@@ -81,13 +81,37 @@ def save_checkpoint(
 
 def _template_sharding(x):
     """Explicit restore target for a template leaf: its own placement if it
-    is a live array, else this process's default device. Never None —
-    orbax's sharding-from-file fallback is both slower and unsafe when
-    restoring on a different topology than the save."""
+    is a live array; else replicated on the ambient mesh when one is set
+    (pinning a large tree to one device OOMs a 16 GB chip, and on
+    multi-host each process would target a different devices()[0]); else
+    this process's default device. Never None — orbax's sharding-from-file
+    fallback is both slower and unsafe when restoring on a different
+    topology than the save."""
     s = getattr(x, "sharding", None)
-    if s is None:
-        s = jax.sharding.SingleDeviceSharding(jax.devices()[0])
-    return s
+    if s is not None:
+        return s
+    mesh = _ambient_mesh()
+    if mesh is not None:
+        return jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+    return jax.sharding.SingleDeviceSharding(jax.devices()[0])
+
+
+def _ambient_mesh():
+    """The concrete mesh from jax.sharding.set_mesh / `with mesh:`, or
+    None. get_concrete_mesh is in jax._src (no public accessor for the
+    concrete — not abstract — ambient mesh as of jax 0.9), so fail soft."""
+    try:
+        from jax._src import mesh as mesh_lib
+
+        mesh = mesh_lib.get_concrete_mesh()
+        if mesh is not None and not mesh.empty:
+            return mesh
+        legacy = mesh_lib.thread_resources.env.physical_mesh
+        if legacy is not None and not legacy.empty:
+            return legacy
+    except Exception:  # noqa: BLE001 - private API; any change => fallback
+        pass
+    return None
 
 
 def _abstract_like(state: TrainState, shardings=None) -> TrainState:

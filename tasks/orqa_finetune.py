@@ -181,11 +181,13 @@ def orqa_loss(cfg, params, batch, dropout_key=None, score_scaling: bool = False,
     loss, _ = cross_entropy_loss(scores[:, None, :], labels[:, None])
     ranks = jnp.sum(
         scores > jnp.take_along_axis(scores, labels[:, None], axis=1), axis=1)
-    aux = {"loss": loss,
-           "correct": jnp.mean((ranks == 0).astype(jnp.float32))}
+    aux = {"loss": loss}
     for k in topk:
         if k <= scores.shape[1]:
-            aux[f"top{k}_acc"] = jnp.mean((ranks < k).astype(jnp.float32))
+            # percents, the reference's reporting convention
+            # (tasks/orqa/supervised/finetune.py accuracy * 100)
+            aux[f"top{k}_acc"] = 100.0 * jnp.mean(
+                (ranks < k).astype(jnp.float32))
     return loss, aux
 
 
@@ -240,11 +242,12 @@ def orqa_eval(loop, valid_ds, batch: int = 8, score_scaling: bool = False,
             ranks.extend(int(r) for r in vec[:n_real])
     arr = np.asarray(ranks, np.float64)
     # mean of 0-based ranks, matching the reference's get_rank (which sums
-    # 0-based torch.nonzero positions); topk accuracies are fractions, not
-    # the reference's percents
+    # 0-based torch.nonzero positions); topk accuracies in percent, the
+    # reference's reporting convention (so numbers compare 1:1 against
+    # reference logs/thresholds)
     out = {"rank": float(arr.mean())}
     for k in topk:
-        out[f"top{k}_acc"] = float((arr < k).mean())
+        out[f"top{k}_acc"] = 100.0 * float((arr < k).mean())
     return out
 
 

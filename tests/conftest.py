@@ -17,3 +17,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from megatron_tpu.platform import force_cpu  # noqa: E402
 
 force_cpu(8)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: multi-minute test (subprocess compiles etc.)")

@@ -70,7 +70,8 @@ def test_biencoder_loss_and_separate_towers():
     }
     loss, aux = biencoder_loss(CFG, params, batch, topk=(1, 2))
     assert np.isfinite(float(loss))
-    assert 0.0 <= float(aux["top1_acc"]) <= float(aux["top2_acc"]) <= 1.0
+    # accuracies in percent (ref pretrain_ict.py:114)
+    assert 0.0 <= float(aux["top1_acc"]) <= float(aux["top2_acc"]) <= 100.0
     # towers are distinct: embeddings differ for same input
     q = embed_text(CFG, params["query"], batch["query_tokens"],
                    batch["query_pad_mask"] > 0)
@@ -121,7 +122,7 @@ def test_biencoder_learns_in_batch_retrieval():
         if first is None:
             first = float(loss)
     assert float(loss) < first
-    assert float(aux["top1_acc"]) > 1.0 / B
+    assert float(aux["top1_acc"]) > 100.0 / B
 
 
 def test_pretrain_ict_entry_runs(tmp_path):

@@ -216,10 +216,11 @@ def test_orqa_harness_end_to_end(tmp_path):
         ])
     out = buf.getvalue()
     assert "rank" in out and "top1_acc" in out
-    # measured at this config: top1 0.50, top5 0.75, mean rank 4.9 of 48
+    # measured at this config: top1 50%, top5 75%, mean rank 4.9 of 48
+    # (accuracies reported in percent, the reference convention)
     top1 = float(out.rsplit("top1_acc = ", 1)[1].split()[0])
     top5 = float(out.rsplit("top5_acc = ", 1)[1].split()[0])
     rank = float(out.rsplit("rank = ", 1)[1].split()[0])
-    assert top1 > 1.0 / 8   # uniform over the 48-candidate set is 1/48
-    assert top5 > 1.0 / 4
+    assert top1 > 100.0 / 8   # uniform over the 48-candidate set is 100/48
+    assert top5 > 100.0 / 4
     assert rank < 15        # random mean rank is ~24.5

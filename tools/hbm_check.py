@@ -1,0 +1,95 @@
+"""CLI: AOT per-chip HBM-fit check for a (model, topology) on virtual devices.
+
+Compiles the full train step abstractly over a virtual CPU mesh and prints
+XLA's per-chip memory requirement vs a TPU generation's HBM — the
+capacity-planning step before renting a slice (VERDICT r3 next-round #2).
+
+    python tools/hbm_check.py --proof llama2_7b_dp2tp4
+    python tools/hbm_check.py --model llama2 --size 70B --tp 8 --pp 4 \
+        --devices 64 --seq_length 4096 --recompute full --hbm v5p
+"""
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--proof", choices=["llama2_7b_dp2tp4",
+                                       "llama2_70b_dp2tp8pp4"],
+                   help="run a canned headline proof")
+    p.add_argument("--model", default="llama2",
+                   help="preset family (llama/llama2/mistral/falcon/...)")
+    p.add_argument("--size", default="7B")
+    p.add_argument("--seq_length", type=int, default=None)
+    p.add_argument("--params_dtype", default=None,
+                   help="override preset dtype (e.g. float32 to dodge the "
+                        "XLA:CPU bf16-collective bug on pp>1 proofs)")
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--pp", type=int, default=1)
+    p.add_argument("--cp", type=int, default=1)
+    p.add_argument("--sequence_parallel", action="store_true")
+    p.add_argument("--devices", type=int, default=8,
+                   help="virtual device count (dp is derived)")
+    p.add_argument("--micro_batch_size", type=int, default=1)
+    p.add_argument("--num_microbatches", type=int, default=2)
+    p.add_argument("--recompute", default="selective",
+                   choices=["none", "selective", "full"])
+    p.add_argument("--hbm", default="v4", choices=["v4", "v5e", "v5p"],
+                   help="budget generation")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    args = p.parse_args(argv)
+
+    from megatron_tpu.platform import force_cpu
+
+    if args.proof:
+        from megatron_tpu.training.aot import SCALE_PROOFS  # jax-free import
+
+        # a canned proof knows its own device count; --devices can only
+        # raise it
+        force_cpu(max(args.devices, SCALE_PROOFS[args.proof][2]))
+    else:
+        force_cpu(args.devices)
+
+    from megatron_tpu.training.aot import (
+        HBM_BYTES, SCALE_PROOFS, hbm_fit_report, run_scale_proof,
+    )
+
+    if args.proof:
+        budget = SCALE_PROOFS[args.proof][1]
+        rep = run_scale_proof(args.proof)
+    else:
+        from megatron_tpu.config import ParallelConfig
+        from megatron_tpu.models import presets
+
+        kw = {"seq_length": args.seq_length} if args.seq_length else {}
+        cfg = presets.PRESETS[args.model](size=args.size, **kw)
+        if args.params_dtype:
+            cfg = dataclasses.replace(
+                cfg, params_dtype=args.params_dtype).validate()
+        par = ParallelConfig(tensor_parallel=args.tp,
+                             pipeline_parallel=args.pp,
+                             context_parallel=args.cp,
+                             sequence_parallel=args.sequence_parallel)
+        budget = HBM_BYTES[args.hbm]
+        rep = hbm_fit_report(cfg, par,
+                             micro_batch_size=args.micro_batch_size,
+                             num_microbatches=args.num_microbatches,
+                             recompute=args.recompute)
+    if args.as_json:
+        print(json.dumps({**dataclasses.asdict(rep),
+                          "per_chip_bytes": rep.per_chip_bytes,
+                          "budget_bytes": budget,
+                          "fits": rep.fits(budget)}))
+    else:
+        print(rep.summary(budget))
+    return 0 if rep.fits(budget) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
