@@ -266,7 +266,15 @@ def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
                    default=True,
                    help="validation ppl always goes to the writer here")
     g.add_argument("--log_timers_to_tensorboard", action="store_true",
-                   help="ref alias: raises --timing_log_level to 1")
+                   help="per-span timer scalars each log_interval "
+                        "(also raises --timing_log_level to 1)")
+    g.add_argument("--profile", action="store_true",
+                   help="jax.profiler trace window (TPU-native nsys "
+                        "equivalent) for steps [start, end)")
+    g.add_argument("--profile_step_start", type=int, default=10)
+    g.add_argument("--profile_step_end", type=int, default=12)
+    g.add_argument("--profile_dir", default=None,
+                   help="trace output dir (default: --tensorboard_dir)")
 
     if extra_args_provider is not None:
         extra_args_provider(p)
@@ -464,6 +472,12 @@ def args_to_run_config(args) -> RunConfig:
         wandb_project=getattr(args, "wandb_project", "megatron_tpu"),
         wandb_name=getattr(args, "wandb_name", None),
         timing_log_level=args.timing_log_level,
+        log_timers_to_tensorboard=getattr(args, "log_timers_to_tensorboard",
+                                          False),
+        profile=getattr(args, "profile", False),
+        profile_step_start=getattr(args, "profile_step_start", 10),
+        profile_step_end=getattr(args, "profile_step_end", 12),
+        profile_dir=getattr(args, "profile_dir", None),
         eval_only=getattr(args, "eval_only", False),
         skip_iters=tuple(getattr(args, "skip_iters", []) or []),
         log_params_norm=getattr(args, "log_params_norm", False),

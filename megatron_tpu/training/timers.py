@@ -73,6 +73,12 @@ class Timers:
             self._timers[name] = _Timer(name)
         return self._timers[name]
 
+    def elapsed_ms(self, names=None, reset: bool = True) -> Dict[str, float]:
+        """{span: accumulated ms since last reset} (for writer scalars)."""
+        names = names if names is not None else sorted(self._timers)
+        return {n: self._timers[n].elapsed(reset) * 1000.0
+                for n in names if n in self._timers}
+
     def log_string(self, names=None, normalizer: float = 1.0,
                    reset: bool = True) -> str:
         names = names if names is not None else sorted(self._timers)

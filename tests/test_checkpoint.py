@@ -103,6 +103,47 @@ def test_config_compat_check():
     with pytest.raises(ValueError, match="num_layers"):
         checkpointing.check_config_compatibility(
             {"model": {"num_layers": 2}}, {"model": {"num_layers": 4}})
+    # same-shape drift (weights restore cleanly but the forward function
+    # differs) must be caught too — the silent-killer class
+    with pytest.raises(ValueError, match="rope_theta"):
+        checkpointing.check_config_compatibility(
+            {"model": {"rope_theta": 1e4}}, {"model": {"rope_theta": 1e6}})
+    # all mismatches reported at once
+    with pytest.raises(ValueError, match="(?s)normalization.*activation"):
+        checkpointing.check_config_compatibility(
+            {"model": {"normalization": "rmsnorm", "activation": "swiglu"}},
+            {"model": {"normalization": "layernorm", "activation": "gelu"}})
+
+
+def test_resume_with_mismatched_config_raises(tmp_path):
+    """A resume against a same-shape-drifted config fails loudly BEFORE
+    restore, and --finetune deliberately bypasses the check (VERDICT r3
+    next-round #3; ref: check_checkpoint_args, checkpointing.py:35-66)."""
+    import dataclasses
+
+    cfg, state = _state()
+    _, template = _state(seed=99)
+    saved_cfg = {"model": dataclasses.asdict(cfg), "parallel": {},
+                 "optimizer": {}, "training": {}}
+    checkpointing.save_checkpoint(str(tmp_path), state, iteration=1,
+                                  consumed_samples=4, config=saved_cfg)
+
+    drifted = {**saved_cfg,
+               "model": {**saved_cfg["model"], "rope_theta": 1e6}}
+    with pytest.raises(ValueError, match="rope_theta"):
+        checkpointing.load_checkpoint(str(tmp_path), template,
+                                      config=drifted)
+    # same config resumes; finetune adopts the weights despite the drift
+    _, it, _ = checkpointing.load_checkpoint(str(tmp_path), template,
+                                             config=saved_cfg)
+    assert it == 1
+    restored, it, consumed = checkpointing.load_checkpoint(
+        str(tmp_path), template, config=drifted, finetune=True)
+    assert (it, consumed) == (0, 0)
+    # a topology change is NOT an architecture change: parallel/training
+    # sections are never part of the check
+    retopo = {**saved_cfg, "parallel": {"tensor_parallel": 2}}
+    checkpointing.load_checkpoint(str(tmp_path), template, config=retopo)
 
 
 def test_checkpoint_util_copy_and_cast(tmp_path):
