@@ -226,6 +226,228 @@ def ring_attention(
     return out.astype(q.dtype)
 
 
+# ---------------------------------------------------------------------------
+# flash-inner zig-zag ring (VERDICT r3 next-round #5)
+#
+# The einsum inner step above materializes fp32 scores
+# [B, Hkv, G, Sq_local, Skv_local] every ring hop. This path replaces each
+# stripe-level einsum with the in-tree Pallas flash kernel
+# (ops/pallas/flash_attention.py), whose VMEM-blocked online softmax never
+# materializes a score buffer. Per stripe pair only two mask cases exist
+# under causality — aligned-diagonal (src == my) or fully visible — so the
+# kernel's static causal flag suffices, selected per hop by lax.cond.
+#
+# Differentiation: one custom_vjp over the WHOLE ring. The forward saves
+# (q, k, v, out, per-stripe lse); the backward replays the K/V ring and
+# calls the kernel's backward per stripe-hop with the GLOBAL lse — the
+# FlashAttention-2 recompute scheme (p = exp(s - lse_global)) makes
+# per-block gradients sum to the exact dense gradient, with dk/dv
+# accumulated in carries that rotate home with their blocks.
+
+
+def _merge_normalized(st, o_i, lse_i):
+    """Merge a block's (normalized out, lse) into the running pair."""
+    out, lse = st
+    m = jnp.maximum(lse, lse_i)
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    w_old = jnp.where(jnp.isfinite(lse), jnp.exp(lse - m_safe), 0.0)
+    w_new = jnp.where(jnp.isfinite(lse_i), jnp.exp(lse_i - m_safe), 0.0)
+    tot = jnp.maximum(w_old + w_new, 1e-30)
+    new_out = (out * w_old[..., None] + o_i * w_new[..., None]) / tot[..., None]
+    new_lse = jnp.where(w_old + w_new > 0.0, m_safe + jnp.log(tot),
+                        -jnp.inf)
+    return new_out, new_lse
+
+
+def _rep_bhsd(x, groups):
+    """[B, c, Hkv, D] -> [B, Hq, c, D] (kv heads repeated per group — the
+    in-tree kernel runs per query head)."""
+    xt = jnp.transpose(x, (0, 2, 1, 3))
+    return jnp.repeat(xt, groups, axis=1) if groups > 1 else xt
+
+
+def _stripe_fwd(q, k, v, diag, scale, block):
+    """(o, lse) for one stripe pair, [B, H, c, D] layout; `diag` (traced)
+    picks the aligned-causal kernel vs the fully-visible one."""
+    from megatron_tpu.ops.pallas import flash_attention as fa
+
+    o, lse = jax.lax.cond(
+        diag,
+        lambda: fa._fwd(q, k, v, scale, True, None, block, block),
+        lambda: fa._fwd(q, k, v, scale, False, None, block, block))
+    return o.astype(jnp.float32), lse[..., 0]
+
+
+def _stripe_bwd(q, k, v, o, lse, do, diag, scale, block):
+    """(dq, dk, dv) for one stripe pair given the GLOBAL lse."""
+    from megatron_tpu.ops.pallas import flash_attention as fa
+
+    lse128 = jnp.broadcast_to(lse[..., None], lse.shape + (128,))
+    return jax.lax.cond(
+        diag,
+        lambda: fa._bwd(q, k, v, o, lse128, do, scale, True, None,
+                        block, block),
+        lambda: fa._bwd(q, k, v, o, lse128, do, scale, False, None,
+                        block, block))
+
+
+def _pick_stripe_block(c: int) -> int:
+    """Largest tier the stripe length supports (same tiering as the
+    kernel's own _pick_block), falling back to c itself for the tiny
+    shapes CPU interpret tests force through."""
+    from megatron_tpu.ops.pallas.flash_attention import _pick_block
+
+    return _pick_block(c) or c
+
+
+def _zigzag_flash_fwd_impl(q, k, v, axis_name, block):
+    """Forward ring; q/k/v [B, sq, H, D] local zig-zag layout. Returns
+    (out [B, sq, Hq, D], lse_lo, lse_hi [B, Hq, c])."""
+    b, sq, hq, d = q.shape
+    hkv = k.shape[2]
+    groups = hq // hkv
+    cp = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    c = sq // 2
+    scale = float(1.0 / (d ** 0.5))
+
+    qt = jnp.transpose(q, (0, 2, 1, 3))              # [B, Hq, sq, D]
+    q_lo, q_hi = qt[:, :, :c], qt[:, :, c:]
+
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    def init_st():
+        return (jnp.zeros((b, hq, c, d), jnp.float32),
+                jnp.full((b, hq, c), -jnp.inf, jnp.float32))
+
+    def guarded_merge(pred, st, qs, ks, vs, diag):
+        def do(st):
+            return _merge_normalized(
+                st, *_stripe_fwd(qs, ks, vs, diag, scale, block))
+
+        return jax.lax.cond(pred, do, lambda st: st, st)
+
+    def step(carry, r):
+        kc, vc, st_lo, st_hi = carry
+        src = (my - r) % cp
+        k_lo, k_hi = _rep_bhsd(kc[:, :c], groups), _rep_bhsd(kc[:, c:], groups)
+        v_lo, v_hi = _rep_bhsd(vc[:, :c], groups), _rep_bhsd(vc[:, c:], groups)
+        # stripe reachability/diagonal structure: see ring_attention_zigzag
+        st_lo = guarded_merge(src <= my, st_lo, q_lo, k_lo, v_lo, src == my)
+        st_hi = guarded_merge(True, st_hi, q_hi, k_lo, v_lo, jnp.bool_(False))
+        st_hi = guarded_merge(src >= my, st_hi, q_hi, k_hi, v_hi, src == my)
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        return (kc, vc, st_lo, st_hi), None
+
+    (_, _, (o_lo, lse_lo), (o_hi, lse_hi)), _ = jax.lax.scan(
+        step, (k, v, init_st(), init_st()), jnp.arange(cp))
+    out = jnp.concatenate([o_lo, o_hi], axis=2)      # [B, Hq, sq, D]
+    out = jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+    return out, lse_lo, lse_hi
+
+
+def _zigzag_flash(q, k, v, *, axis_name, block):
+    out, _, _ = _zigzag_flash_fwd_impl(q, k, v, axis_name, block)
+    return out
+
+
+def _make_zigzag_flash(axis_name: str, block: int):
+    """custom_vjp wrapper (axis_name/block closed over — they are
+    configuration, not differentiable inputs)."""
+
+    @jax.custom_vjp
+    def fn(q, k, v):
+        return _zigzag_flash(q, k, v, axis_name=axis_name, block=block)
+
+    def fwd(q, k, v):
+        out, lse_lo, lse_hi = _zigzag_flash_fwd_impl(
+            q, k, v, axis_name, block)
+        return out, (q, k, v, out, lse_lo, lse_hi)
+
+    def bwd(res, do):
+        q, k, v, out, lse_lo, lse_hi = res
+        b, sq, hq, d = q.shape
+        hkv = k.shape[2]
+        groups = hq // hkv
+        cp = jax.lax.axis_size(axis_name)
+        my = jax.lax.axis_index(axis_name)
+        c = sq // 2
+        scale = float(1.0 / (d ** 0.5))
+
+        qt = jnp.transpose(q, (0, 2, 1, 3))
+        ot = jnp.transpose(out, (0, 2, 1, 3))
+        dt = jnp.transpose(do, (0, 2, 1, 3))
+        q_lo, q_hi = qt[:, :, :c], qt[:, :, c:]
+        o_lo, o_hi = ot[:, :, :c], ot[:, :, c:]
+        do_lo, do_hi = dt[:, :, :c], dt[:, :, c:]
+
+        perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+        def group_sum(dx):
+            """[B, Hq, c, D] -> [B, c, Hkv, D] (sum query groups, back to
+            framework head layout)."""
+            dx = dx.reshape(b, hkv, groups, c, d).sum(axis=2)
+            return jnp.transpose(dx, (0, 2, 1, 3))
+
+        def guarded_bwd(pred, qs, ks, vs, os_, lses, dos, diag):
+            def run():
+                return _stripe_bwd(qs, _rep_bhsd(ks, groups),
+                                   _rep_bhsd(vs, groups), os_, lses, dos,
+                                   diag, scale, block)
+
+            def zero():
+                z_q = jnp.zeros((b, hq, c, d), qs.dtype)
+                z_kv = jnp.zeros((b, hq, c, d), qs.dtype)
+                return z_q, z_kv, z_kv
+
+            return jax.lax.cond(pred, run, zero)
+
+        def step(carry, r):
+            kc, vc, dkc, dvc, dq_lo, dq_hi = carry
+            src = (my - r) % cp
+            k_lo, k_hi = kc[:, :c], kc[:, c:]
+            v_lo, v_hi = vc[:, :c], vc[:, c:]
+
+            dq1, dk1, dv1 = guarded_bwd(src <= my, q_lo, k_lo, v_lo,
+                                        o_lo, lse_lo, do_lo, src == my)
+            dq2, dk2, dv2 = guarded_bwd(True, q_hi, k_lo, v_lo,
+                                        o_hi, lse_hi, do_hi,
+                                        jnp.bool_(False))
+            dq3, dk3, dv3 = guarded_bwd(src >= my, q_hi, k_hi, v_hi,
+                                        o_hi, lse_hi, do_hi, src == my)
+
+            dq_lo = dq_lo + dq1.astype(jnp.float32)
+            dq_hi = dq_hi + (dq2 + dq3).astype(jnp.float32)
+            dk_add = jnp.concatenate(
+                [group_sum(dk1) + group_sum(dk2), group_sum(dk3)], axis=1)
+            dv_add = jnp.concatenate(
+                [group_sum(dv1) + group_sum(dv2), group_sum(dv3)], axis=1)
+            dkc = dkc + dk_add.astype(jnp.float32)
+            dvc = dvc + dv_add.astype(jnp.float32)
+
+            # dk/dv carries rotate WITH their blocks: after cp hops each
+            # block (and its accumulated gradient) is home again
+            kc = jax.lax.ppermute(kc, axis_name, perm)
+            vc = jax.lax.ppermute(vc, axis_name, perm)
+            dkc = jax.lax.ppermute(dkc, axis_name, perm)
+            dvc = jax.lax.ppermute(dvc, axis_name, perm)
+            return (kc, vc, dkc, dvc, dq_lo, dq_hi), None
+
+        zeros_kv = jnp.zeros((b, sq, hkv, d), jnp.float32)
+        zeros_q = jnp.zeros((b, hq, c, d), jnp.float32)
+        (_, _, dkc, dvc, dq_lo, dq_hi), _ = jax.lax.scan(
+            step, (k, v, zeros_kv, zeros_kv, zeros_q, zeros_q),
+            jnp.arange(cp))
+
+        dq = jnp.concatenate([dq_lo, dq_hi], axis=2)  # [B, Hq, sq, D]
+        dq = jnp.transpose(dq, (0, 2, 1, 3)).astype(q.dtype)
+        return dq, dkc.astype(k.dtype), dvc.astype(v.dtype)
+
+    fn.defvjp(fwd, bwd)
+    return fn
+
+
 def _zigzag_perm(S: int, cp: int):
     """new-position -> old-global-index so contiguous local blocks become
     (stripe r, stripe 2cp-1-r) per rank r."""
@@ -249,6 +471,7 @@ def ring_attention_sharded(
     mesh=None,
     mask_type: str = "causal",
     sliding_window: Optional[int] = None,
+    inner_impl: Optional[str] = None,
 ) -> jnp.ndarray:
     """GSPMD-callable wrapper: context axis manual, everything else auto.
 
@@ -258,7 +481,12 @@ def ring_attention_sharded(
     resharding against the O(S^2) attention it halves; keeping the whole
     residual stream in zig-zag order would amortize even that, at the
     cost of position-dependent ops everywhere — deliberately not done).
-    The contiguous path remains for non-causal masks and odd lengths."""
+    The contiguous path remains for non-causal masks and odd lengths.
+
+    inner_impl: None/"auto" = flash stripes on TPU when the shape allows
+    (plain causal, stripe length % 128), einsum elsewhere; "flash"/"einsum"
+    force a path (flash forcing is how CPU tests exercise the kernel via
+    the pallas interpreter)."""
     use_mesh = mesh
     if use_mesh is None:
         from jax.sharding import get_abstract_mesh
@@ -267,10 +495,27 @@ def ring_attention_sharded(
     cp = use_mesh.shape.get(AXIS_CONTEXT, 1) if use_mesh is not None else 1
     S = q.shape[1]
     if mask_type == "causal" and cp > 1 and S % (2 * cp) == 0:
+        c = S // (2 * cp)
+        if inner_impl is None or inner_impl == "auto":
+            from megatron_tpu.ops.pallas.flash_attention import _interpret
+
+            # sliding-window stripes need shifted window masks the static
+            # kernel flags cannot express — the einsum path keeps them
+            use_flash = (sliding_window is None and c % 128 == 0
+                         and not _interpret())
+        else:
+            use_flash = inner_impl == "flash"
+        if use_flash and sliding_window is not None:
+            raise ValueError("inner_impl='flash' does not support "
+                             "sliding_window; use the einsum path")
+        if use_flash:
+            inner = _make_zigzag_flash(AXIS_CONTEXT, _pick_stripe_block(c))
+        else:
+            inner = lambda q, k, v: ring_attention_zigzag(  # noqa: E731
+                q, k, v, sliding_window=sliding_window)
         perm, inv = _zigzag_perm(S, cp)
         fn = jax.shard_map(
-            lambda q, k, v: ring_attention_zigzag(
-                q, k, v, sliding_window=sliding_window),
+            inner,
             mesh=mesh,
             in_specs=(P(None, AXIS_CONTEXT), P(None, AXIS_CONTEXT),
                       P(None, AXIS_CONTEXT)),
@@ -282,6 +527,12 @@ def ring_attention_sharded(
                  jnp.take(v, perm, axis=1))
         return jnp.take(out, inv, axis=1)
 
+    if inner_impl == "flash":
+        # a forced flash request must not silently run einsum
+        raise ValueError(
+            "inner_impl='flash' needs the zig-zag branch: causal mask, "
+            f"cp > 1 and S % (2*cp) == 0 (got mask_type={mask_type!r}, "
+            f"cp={cp}, S={S})")
     fn = jax.shard_map(
         lambda q, k, v: ring_attention(
             q, k, v, mask_type=mask_type, sliding_window=sliding_window),

@@ -76,6 +76,84 @@ def test_ring_zigzag_window_grads_match_dense():
                                    rtol=5e-4, atol=5e-5)
 
 
+@pytest.mark.parametrize("cp", [2, 4])
+def test_ring_flash_inner_matches_dense(cp):
+    """The flash-stripe zig-zag path (forced through the pallas
+    interpreter on CPU) is value-exact against dense attention — no
+    per-hop dense score buffer, same math (VERDICT r3 next-round #5)."""
+    rt = build_mesh(ParallelConfig(context_parallel=cp))
+    q, k, v = _qkv()
+    want = attention(q, k, v, mask_type="causal")
+    with jax.sharding.set_mesh(rt.mesh):
+        got = jax.jit(lambda q, k, v: ring_attention_sharded(
+            q, k, v, rt.mesh, inner_impl="flash"))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_ring_flash_inner_grads_match_dense():
+    """Whole-ring custom_vjp: per-stripe kernel backwards with the global
+    lse must sum to the exact dense gradient, dk/dv rotating home."""
+    rt = build_mesh(ParallelConfig(context_parallel=4))
+    q, k, v = _qkv(b=1, s=32, hq=2, hkv=1, d=8)
+
+    def dense_loss(q, k, v):
+        return jnp.sum(jnp.square(attention(q, k, v)))
+
+    def ring_loss(q, k, v):
+        return jnp.sum(jnp.square(ring_attention_sharded(
+            q, k, v, rt.mesh, inner_impl="flash")))
+
+    g_dense = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    with jax.sharding.set_mesh(rt.mesh):
+        g_ring = jax.jit(jax.grad(ring_loss, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g_ring, g_dense):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5)
+
+
+def test_ring_flash_inner_gqa_grads():
+    """GQA: kernel runs per query head; dk/dv group-sum back to kv heads."""
+    rt = build_mesh(ParallelConfig(context_parallel=2))
+    q, k, v = _qkv(b=1, s=16, hq=4, hkv=2, d=8)
+
+    def dense_loss(q, k, v):
+        return jnp.sum(jnp.square(attention(q, k, v)))
+
+    def ring_loss(q, k, v):
+        return jnp.sum(jnp.square(ring_attention_sharded(
+            q, k, v, rt.mesh, inner_impl="flash")))
+
+    g_dense = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    with jax.sharding.set_mesh(rt.mesh):
+        g_ring = jax.jit(jax.grad(ring_loss, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g_ring, g_dense):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5)
+
+
+def test_ring_flash_rejects_window():
+    rt = build_mesh(ParallelConfig(context_parallel=2))
+    q, k, v = _qkv()
+    with pytest.raises(ValueError, match="sliding_window"):
+        with jax.sharding.set_mesh(rt.mesh):
+            ring_attention_sharded(q, k, v, rt.mesh, inner_impl="flash",
+                                   sliding_window=8)
+
+
+def test_cp_decode_fallback_warns():
+    """Decode steps under a CP impl fall back to XLA LOUDLY now."""
+    import warnings as w
+
+    q = jnp.asarray(RNG.standard_normal((1, 1, 2, 8)).astype(np.float32))
+    k = jnp.asarray(RNG.standard_normal((1, 16, 2, 8)).astype(np.float32))
+    v = jnp.asarray(RNG.standard_normal((1, 16, 2, 8)).astype(np.float32))
+    with w.catch_warnings(record=True) as caught:
+        w.simplefilter("always")
+        attention(q, k, v, impl="ring", q_offset=15)
+    assert any("KV-cache decode/prefill" in str(c.message) for c in caught)
+
+
 def test_model_forward_with_ring_impl():
     """Full model with attention_impl='ring' on a cp=2 mesh matches the
     xla-impl forward."""
