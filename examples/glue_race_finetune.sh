@@ -10,7 +10,8 @@ python -m tasks.main --task MNLI \
     --seq_length 128 --vocab_size 30592 \
     --tokenizer_type HF --tokenizer_model bert-large-uncased \
     --epochs 3 --micro_batch_size 8 --global_batch_size 128 \
-    --lr 5e-5 --lr_decay_style linear --lr_warmup_fraction 0.065 --bf16
+    --lr 5e-5 --lr_decay_style linear --lr_warmup_fraction 0.065 --bf16 \
+    --head_lr_mult 10.0   # fresh head learns faster than the encoder
 
 python -m tasks.main --task RACE \
     --train_data race/train/middle race/train/high \

@@ -70,6 +70,11 @@ def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
     g.add_argument("--moe_group_size", type=int, default=None,
                    help="GShard dispatch group size (tokens); 0 = auto "
                         "(largest divisor of seq_length <= 2048)")
+    g.add_argument("--moe_dispatch", choices=["capacity", "dropless"],
+                   default=None,
+                   help="capacity: GShard einsum dispatch (EP-shardable); "
+                        "dropless: sort + lax.ragged_dot grouped GEMMs, "
+                        "no token drops (ep=1 only)")
     g.add_argument("--moe_renorm_gates", action="store_true", default=None)
     g.add_argument("--no_moe_renorm_gates", action="store_false",
                    dest="moe_renorm_gates",
@@ -95,6 +100,9 @@ def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
     g.add_argument("--end_weight_decay", type=float, default=None)
     g.add_argument("--weight_decay_incr_style", default="constant")
     g.add_argument("--clip_grad", type=float, default=1.0)
+    g.add_argument("--head_lr_mult", type=float, default=1.0,
+                   help="LR multiplier for task-head params during "
+                        "finetuning (ref --head_lr_mult)")
 
     g = p.add_argument_group("training")
     g.add_argument("--micro_batch_size", type=int, default=1)
@@ -183,6 +191,9 @@ def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
     g = p.add_argument_group("distributed")
     g.add_argument("--tensor_model_parallel_size", type=int, default=1)
     g.add_argument("--pipeline_model_parallel_size", type=int, default=1)
+    g.add_argument("--expert_model_parallel_size", type=int, default=1,
+                   help="MoE expert-parallel degree (dedicated mesh axis; "
+                        "E % ep == 0, dp unconstrained)")
     g.add_argument("--context_parallel_size", type=int, default=1)
     g.add_argument("--num_layers_per_virtual_pipeline_stage", type=int,
                    default=None,
@@ -287,7 +298,7 @@ def _moe_overrides(args) -> dict:
     out = {}
     for name in ("num_experts", "moe_top_k", "moe_capacity_factor",
                  "moe_aux_loss_coeff", "moe_z_loss_coeff",
-                 "moe_renorm_gates", "moe_group_size"):
+                 "moe_renorm_gates", "moe_group_size", "moe_dispatch"):
         v = getattr(args, name, None)
         if v is not None:
             out[name] = v
@@ -417,6 +428,7 @@ def args_to_run_config(args) -> RunConfig:
         tensor_parallel=args.tensor_model_parallel_size,
         pipeline_parallel=args.pipeline_model_parallel_size,
         context_parallel=args.context_parallel_size,
+        expert_parallel=getattr(args, "expert_model_parallel_size", 1),
         sequence_parallel=args.sequence_parallel,
         virtual_pipeline_parallel=vpp if (vpp or 0) > 1 else None,
     ).validate()
@@ -437,6 +449,14 @@ def args_to_run_config(args) -> RunConfig:
         end_weight_decay=args.end_weight_decay,
         weight_decay_incr_style=args.weight_decay_incr_style,
         clip_grad=args.clip_grad,
+        # task heads: classification_head (GLUE and RACE — multichoice
+        # reuses the same param name), the ICT/DPR retrieval heads, and
+        # BERT's binary head — the param-path form of the reference's
+        # scale_lr_cond param groups
+        param_group_mults=(
+            (("(^|/)(classification_head|ict_head|binary_head)(/|$)",
+              args.head_lr_mult, 1.0),)
+            if getattr(args, "head_lr_mult", 1.0) != 1.0 else ()),
         use_distributed_optimizer=args.use_distributed_optimizer,
         loss_scale=args.loss_scale,
         initial_loss_scale=args.initial_loss_scale,

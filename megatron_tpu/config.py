@@ -63,6 +63,13 @@ class ModelConfig:
     vocab_size: int
     seq_length: int
 
+    # encoder-decoder models (T5) may give the two stacks different
+    # depths (ref: --encoder_num_layers / --decoder_num_layers,
+    # megatron/arguments.py); None = num_layers. Decoder-only models
+    # ignore both.
+    encoder_num_layers: Optional[int] = None
+    decoder_num_layers: Optional[int] = None
+
     # grouped-/multi-query attention (ref: transformer.py:450-465
     # num_attention_heads_kv broadcast trick). None => MHA.
     num_kv_heads: Optional[int] = None
@@ -115,6 +122,11 @@ class ModelConfig:
     moe_aux_loss_coeff: float = 1e-2
     moe_z_loss_coeff: float = 0.0
     moe_renorm_gates: bool = True
+    # "capacity": GShard grouped capacity dispatch (einsum, EP-shardable);
+    # "dropless": sort-based dispatch over lax.ragged_dot — NO token ever
+    # dropped and no dense [.., E, C] dispatch FLOPs; single expert group
+    # only (ep == 1)
+    moe_dispatch: str = "capacity"
     # GShard token-group size for dispatch: capacity is enforced within
     # fixed-size groups of tokens so the combine/dispatch tensors are
     # [G, Sg, E, Cg] — linear in total tokens — instead of the global
@@ -213,6 +225,10 @@ class ModelConfig:
                 raise ValueError(
                     f"moe_top_k={self.moe_top_k} must be in "
                     f"[1, num_experts={self.num_experts}]")
+            if self.moe_dispatch not in ("capacity", "dropless"):
+                raise ValueError(
+                    f"moe_dispatch={self.moe_dispatch!r} must be "
+                    "'capacity' or 'dropless'")
             if self.moe_group_size < 0:
                 raise ValueError("moe_group_size must be >= 0")
             if self.moe_group_size and self.seq_length % self.moe_group_size:
@@ -272,6 +288,12 @@ class ParallelConfig:
     # long-context axis (beyond reference parity; ref has only
     # Korthikanti-style SP, see SURVEY.md §2.2).
     context_parallel: int = 1
+    # expert parallelism: a sub-axis of data parallelism that MoE expert
+    # weights shard over (E % expert_parallel == 0); dense params are
+    # replicated over it and the batch shards over (data, expert), so it
+    # behaves as extra DP outside MoE blocks. Decoupled from dp so the
+    # expert count never constrains the data-parallel degree.
+    expert_parallel: int = 1
     # data_parallel: None => derived from device count
     data_parallel: Optional[int] = None
     # Korthikanti sequence parallelism: shard residual-stream activations
@@ -283,19 +305,22 @@ class ParallelConfig:
     virtual_pipeline_parallel: Optional[int] = None
 
     def derive_data_parallel(self, n_devices: int) -> int:
-        model_devices = self.tensor_parallel * self.pipeline_parallel * self.context_parallel
+        model_devices = (self.tensor_parallel * self.pipeline_parallel
+                         * self.context_parallel * self.expert_parallel)
         if n_devices % model_devices:
             raise ValueError(
-                f"{n_devices} devices not divisible by tp*pp*cp={model_devices}")
+                f"{n_devices} devices not divisible by "
+                f"tp*pp*cp*ep={model_devices}")
         dp = n_devices // model_devices
         if self.data_parallel is not None and self.data_parallel != dp:
             raise ValueError(
                 f"data_parallel={self.data_parallel} inconsistent with "
-                f"{n_devices} devices / (tp*pp*cp={model_devices})")
+                f"{n_devices} devices / (tp*pp*cp*ep={model_devices})")
         return dp
 
     def validate(self) -> "ParallelConfig":
-        for name in ("tensor_parallel", "pipeline_parallel", "context_parallel"):
+        for name in ("tensor_parallel", "pipeline_parallel",
+                     "context_parallel", "expert_parallel"):
             if getattr(self, name) < 1:
                 raise ValueError(f"{name} must be >= 1")
         if self.virtual_pipeline_parallel is not None:
@@ -340,6 +365,13 @@ class OptimizerConfig:
     start_weight_decay: Optional[float] = None
     end_weight_decay: Optional[float] = None
     weight_decay_incr_style: str = "constant"  # constant | linear | cosine
+
+    # per-group LR/WD multipliers: ((path_regex, lr_mult, wd_mult), ...) —
+    # first matching pattern wins, unmatched params use (1.0, 1.0). The
+    # param "group" is a path predicate over the param tree, replacing the
+    # reference's torch param_groups carrying lr_mult/wd_mult
+    # (ref: optimizer_param_scheduler.py:124-127, optimizer/__init__.py:16-59)
+    param_group_mults: tuple = ()
 
     clip_grad: float = 1.0
     # ZeRO-1: shard optimizer state over the data axis

@@ -28,7 +28,8 @@ from megatron_tpu.ops.normalization import norm_forward
 
 
 def t5_config(
-    num_layers: int = 12,          # encoder layers == decoder layers (ref)
+    num_layers: int = 12,          # both stacks unless encoder/decoder
+                                   # depths are given explicitly
     hidden_size: int = 768,
     num_attention_heads: int = 12,
     vocab_size: int = 30592,
@@ -60,8 +61,16 @@ def t5_config(
 # ---------------------------------------------------------------------------
 
 
+def t5_stack_depths(cfg: ModelConfig) -> Tuple[int, int]:
+    """(encoder layers, decoder layers) — asymmetric when the config sets
+    them (ref: --encoder_num_layers / --decoder_num_layers)."""
+    return (cfg.encoder_num_layers or cfg.num_layers,
+            cfg.decoder_num_layers or cfg.num_layers)
+
+
 def t5_param_shapes(cfg: ModelConfig) -> Dict[str, tuple]:
-    h, L = cfg.hidden_size, cfg.num_layers
+    h = cfg.hidden_size
+    Le, Ld = t5_stack_depths(cfg)
     D, nq = cfg.head_dim, cfg.num_attention_heads
     F = cfg.ffn_size * mlp_input_width_factor(cfg.activation)
     Fo = cfg.ffn_size
@@ -70,7 +79,7 @@ def t5_param_shapes(cfg: ModelConfig) -> Dict[str, tuple]:
         "embed/pos": (cfg.max_position_embeddings, h),
     }
 
-    def attn_block(prefix: str):
+    def attn_block(prefix: str, L: int):
         for n in ("wq", "wk", "wv"):
             d[f"{prefix}/{n}"] = (L, h, nq * D)
             if cfg.use_bias_qkv:
@@ -79,14 +88,14 @@ def t5_param_shapes(cfg: ModelConfig) -> Dict[str, tuple]:
         if cfg.use_bias_linear:
             d[f"{prefix}/wo_b"] = (L, h)
 
-    def stack(side: str, cross: bool):
+    def stack(side: str, cross: bool, L: int):
         d[f"{side}/ln1/scale"] = (L, h)
         d[f"{side}/ln1/bias"] = (L, h)
-        attn_block(f"{side}/attn")
+        attn_block(f"{side}/attn", L)
         if cross:
             d[f"{side}/ln_cross/scale"] = (L, h)
             d[f"{side}/ln_cross/bias"] = (L, h)
-            attn_block(f"{side}/cross")
+            attn_block(f"{side}/cross", L)
         d[f"{side}/ln2/scale"] = (L, h)
         d[f"{side}/ln2/bias"] = (L, h)
         d[f"{side}/mlp/w_in"] = (L, h, F)
@@ -96,8 +105,8 @@ def t5_param_shapes(cfg: ModelConfig) -> Dict[str, tuple]:
         if cfg.use_bias_linear:
             d[f"{side}/mlp/w_out_b"] = (L, h)
 
-    stack("encoder", cross=False)
-    stack("decoder", cross=True)
+    stack("encoder", cross=False, L=Le)
+    stack("decoder", cross=True, L=Ld)
     d["encoder/final_ln/scale"] = (h,)
     d["encoder/final_ln/bias"] = (h,)
     d["decoder/final_ln/scale"] = (h,)
@@ -137,7 +146,13 @@ def t5_param_specs(cfg: ModelConfig) -> Dict[str, Any]:
 
 def t5_init_params(cfg: ModelConfig, key: jax.Array) -> Dict[str, Any]:
     shapes = t5_param_shapes(cfg)
-    scaled_std = cfg.init_method_std / math.sqrt(2.0 * cfg.num_layers)
+    Le, Ld = t5_stack_depths(cfg)
+    # output-facing mats scale by the depth of THEIR stack's residual
+    # stream (matches the symmetric case when Le == Ld == num_layers)
+    scaled_std = {
+        "encoder": cfg.init_method_std / math.sqrt(2.0 * Le),
+        "decoder": cfg.init_method_std / math.sqrt(2.0 * Ld),
+    }
     flat = {}
     for path, shape in sorted(shapes.items()):
         if path.endswith("scale"):
@@ -145,7 +160,8 @@ def t5_init_params(cfg: ModelConfig, key: jax.Array) -> Dict[str, Any]:
         elif path.endswith("bias") or path.endswith("_b"):
             flat[path] = jnp.zeros(shape, cfg.dtype)
         else:
-            std = scaled_std if path.endswith(("wo", "w_out")) else cfg.init_method_std
+            std = (scaled_std[path.split("/", 1)[0]]
+                   if path.endswith(("wo", "w_out")) else cfg.init_method_std)
             k = jax.random.fold_in(key, zlib.crc32(path.encode()) & 0x7FFFFFFF)
             flat[path] = (jax.random.normal(k, shape, jnp.float32) * std).astype(cfg.dtype)
     out: Dict[str, Any] = {}

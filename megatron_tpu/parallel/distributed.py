@@ -8,7 +8,7 @@ On TPU pods the runtime discovers topology itself; explicit
 coordinator/num_processes/process_id cover CPU tests and non-TPU clusters.
 
 Design notes:
-  * the mesh keeps ("data", "pipe", "context", "tensor") with tensor
+  * the mesh keeps ("data", "expert", "pipe", "context", "tensor") with tensor
     innermost (ICI-adjacent); across *slices* (DCN) only the data axis is
     split — create_hybrid_device_mesh puts the slice index outermost on
     the data axis, so gradient all-reduce is the only DCN collective,
@@ -29,7 +29,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from megatron_tpu.config import ParallelConfig
-from megatron_tpu.parallel.mesh import AXIS_DATA, MESH_AXES, MeshRuntime
+from megatron_tpu.parallel.mesh import MESH_AXES, MeshRuntime
+from megatron_tpu.parallel.sharding import BATCH_AXES
 
 
 def initialize_distributed(
@@ -82,17 +83,17 @@ def build_multihost_mesh(parallel: ParallelConfig) -> MeshRuntime:
     """DCN-aware mesh over all global devices.
 
     Multi-slice (DCN-connected) topologies split only the data axis across
-    slices: dcn shape (num_slices, 1, 1, 1) x ici shape
-    (dp/num_slices, pp, cp, tp). Single-slice/multi-host-CPU falls back to
-    the plain row-major mesh over jax.devices() (process-contiguous, so
+    slices: dcn shape (num_slices, 1, 1, 1, 1) x ici shape
+    (dp/num_slices, ep, pp, cp, tp). Single-slice/multi-host-CPU falls back
+    to the plain row-major mesh over jax.devices() (process-contiguous, so
     the data axis is outermost across hosts there too).
     """
     parallel = parallel.validate()
     devices = jax.devices()
     dp = parallel.derive_data_parallel(len(devices))
     n_slices = _num_slices(devices)
-    shape = (dp, parallel.pipeline_parallel, parallel.context_parallel,
-             parallel.tensor_parallel)
+    shape = (dp, parallel.expert_parallel, parallel.pipeline_parallel,
+             parallel.context_parallel, parallel.tensor_parallel)
     if n_slices > 1:
         if dp % n_slices:
             raise ValueError(
@@ -101,7 +102,7 @@ def build_multihost_mesh(parallel: ParallelConfig) -> MeshRuntime:
         from jax.experimental import mesh_utils
 
         ici = (dp // n_slices,) + shape[1:]
-        dcn = (n_slices, 1, 1, 1)
+        dcn = (n_slices, 1, 1, 1, 1)
         dev_array = mesh_utils.create_hybrid_device_mesh(
             ici, dcn, devices=devices)
         mesh = Mesh(dev_array, MESH_AXES)
@@ -113,7 +114,7 @@ def build_multihost_mesh(parallel: ParallelConfig) -> MeshRuntime:
 def host_batch_slice(rt: MeshRuntime, global_rows: int) -> Tuple[int, int]:
     """[start, stop) of global batch rows this process must load (the
     reference's per-DP-rank sampler offset, data_samplers.py:76-95)."""
-    sh = NamedSharding(rt.mesh, P(AXIS_DATA))
+    sh = NamedSharding(rt.mesh, P(BATCH_AXES))
     index_map = sh.devices_indices_map((global_rows,))
     mine = [sl[0] for d, sl in index_map.items()
             if d.process_index == jax.process_index()]
@@ -133,7 +134,7 @@ def put_process_local_batch(
     (rows host_batch_slice told it to load)."""
     out = {}
     for k, v in local_batch.items():
-        sh = NamedSharding(rt.mesh, P(AXIS_DATA))
+        sh = NamedSharding(rt.mesh, P(BATCH_AXES))
         global_shape = (global_rows,) + tuple(v.shape[1:])
         out[k] = jax.make_array_from_process_local_data(sh, np.asarray(v),
                                                         global_shape)

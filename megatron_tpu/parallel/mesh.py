@@ -26,15 +26,20 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from megatron_tpu.config import ParallelConfig
 
 AXIS_DATA = "data"
+AXIS_EXPERT = "expert"
 AXIS_PIPE = "pipe"
 AXIS_CONTEXT = "context"
 AXIS_TENSOR = "tensor"
-MESH_AXES = (AXIS_DATA, AXIS_PIPE, AXIS_CONTEXT, AXIS_TENSOR)
+MESH_AXES = (AXIS_DATA, AXIS_EXPERT, AXIS_PIPE, AXIS_CONTEXT, AXIS_TENSOR)
 
-# Sequence ("batch") sharding of activations: batch over data, sequence over
-# context. With sequence_parallel the seq dim is additionally split over
-# tensor in the residual stream (see megatron_tpu/parallel/sharding.py).
-BATCH_SPEC = P(AXIS_DATA, AXIS_CONTEXT)
+# Sequence ("batch") sharding of activations: batch over data AND expert —
+# the expert axis is a sub-axis of data parallelism that MoE expert weights
+# shard over (each ep group holds E/ep experts), so dp degree and expert
+# count no longer constrain each other; for dense compute it is just more
+# data parallelism. Sequence shards over context. With sequence_parallel
+# the seq dim is additionally split over tensor in the residual stream
+# (see megatron_tpu/parallel/sharding.py).
+BATCH_SPEC = P((AXIS_DATA, AXIS_EXPERT), AXIS_CONTEXT)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -58,7 +63,18 @@ class MeshRuntime:
         return self.parallel.context_parallel
 
     @property
+    def ep(self) -> int:
+        return self.parallel.expert_parallel
+
+    @property
     def dp(self) -> int:
+        """Degree the BATCH is sharded over (data x expert axes) — what
+        batch-size / ZeRO math cares about."""
+        return self.data_parallel * self.parallel.expert_parallel
+
+    @property
+    def dp_outer(self) -> int:
+        """Size of the bare "data" axis."""
         return self.data_parallel
 
     def sharding(self, *spec) -> NamedSharding:
@@ -83,8 +99,8 @@ def build_mesh(
     parallel = parallel.validate()
     devices = list(devices if devices is not None else jax.devices())
     dp = parallel.derive_data_parallel(len(devices))
-    shape = (dp, parallel.pipeline_parallel, parallel.context_parallel,
-             parallel.tensor_parallel)
+    shape = (dp, parallel.expert_parallel, parallel.pipeline_parallel,
+             parallel.context_parallel, parallel.tensor_parallel)
     dev_array = np.asarray(devices).reshape(shape)
     mesh = Mesh(dev_array, MESH_AXES)
     return MeshRuntime(mesh=mesh, parallel=parallel, data_parallel=dp)

@@ -30,15 +30,20 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from megatron_tpu.parallel.mesh import (
     AXIS_CONTEXT,
     AXIS_DATA,
+    AXIS_EXPERT,
     AXIS_PIPE,
     AXIS_TENSOR,
     MeshRuntime,
 )
 
+# the batch dimension shards over data AND expert (EP is a sub-axis of DP
+# for everything outside MoE blocks — see mesh.py BATCH_SPEC)
+BATCH_AXES = (AXIS_DATA, AXIS_EXPERT)
+
 
 def batch_spec() -> P:
     """[batch, seq] integer token arrays."""
-    return P(AXIS_DATA, AXIS_CONTEXT)
+    return P(BATCH_AXES, AXIS_CONTEXT)
 
 
 def activation_spec(sequence_parallel: bool) -> P:
@@ -50,8 +55,8 @@ def activation_spec(sequence_parallel: bool) -> P:
     and the reduce-scatter leaving a row-parallel one.
     """
     if sequence_parallel:
-        return P(AXIS_DATA, (AXIS_CONTEXT, AXIS_TENSOR), None)
-    return P(AXIS_DATA, AXIS_CONTEXT, None)
+        return P(BATCH_AXES, (AXIS_CONTEXT, AXIS_TENSOR), None)
+    return P(BATCH_AXES, AXIS_CONTEXT, None)
 
 
 def logits_spec() -> P:
@@ -59,7 +64,7 @@ def logits_spec() -> P:
     then runs on sharded logits; the reference's 3-allreduce
     vocab_parallel_cross_entropy (cross_entropy.py:14-127) becomes XLA-fused
     sharded reductions)."""
-    return P(AXIS_DATA, AXIS_CONTEXT, AXIS_TENSOR)
+    return P(BATCH_AXES, AXIS_CONTEXT, AXIS_TENSOR)
 
 
 def constrain(x: jax.Array, spec: P) -> jax.Array:
@@ -87,7 +92,7 @@ def shard_tree(runtime: MeshRuntime, tree: Any, spec_tree: Any) -> Any:
 # ---------------------------------------------------------------------------
 
 
-def zero1_spec(spec: P, shape: tuple, dp: int) -> P:
+def zero1_spec(spec: P, shape: tuple, dp: int, ep: int = 1) -> P:
     """Extend a parameter spec so optimizer state also shards over "data".
 
     TPU-native ZeRO-1 (ref: megatron/optimizer/distrib_optimizer.py, 700 LoC
@@ -102,23 +107,37 @@ def zero1_spec(spec: P, shape: tuple, dp: int) -> P:
     if dp <= 1:
         return spec
     entries = list(spec) + [None] * (len(shape) - len(spec))
-    if any(e == AXIS_DATA or (isinstance(e, tuple) and AXIS_DATA in e)
-           for e in entries):
-        # already data-sharded (expert-parallel MoE weights): the state is
-        # distributed over dp as-is; adding the axis again would be invalid
+
+    def has(axis):
+        return any(e == axis or (isinstance(e, tuple) and axis in e)
+                   for e in entries)
+
+    if has(AXIS_DATA):
+        # already data-sharded: the state is distributed over dp as-is;
+        # adding the axis again would be invalid
+        return spec
+    # `dp` is the TOTAL batch degree (data x expert). Expert-parallel MoE
+    # weights already consume the expert axis on their expert dim, so
+    # their state shards over bare "data" (degree dp/ep); everything else
+    # shards over the combined (data, expert) pair.
+    if has(AXIS_EXPERT):
+        add, degree = AXIS_DATA, dp // ep
+    else:
+        add, degree = BATCH_AXES, dp
+    if degree <= 1:
         return spec
     for i, (axes, dim) in enumerate(zip(entries, shape)):
-        if axes is None and dim % dp == 0:
-            entries[i] = AXIS_DATA
+        if axes is None and dim % degree == 0:
+            entries[i] = add
             return P(*entries)
     return spec  # nothing divisible — leave replicated over data
 
 
-def zero1_spec_tree(spec_tree: Any, params: Any, dp: int) -> Any:
+def zero1_spec_tree(spec_tree: Any, params: Any, dp: int, ep: int = 1) -> Any:
     """`params` may be a pytree of arrays or ShapeDtypeStructs (same
     structure as spec_tree)."""
     return jax.tree.map(
-        lambda s, p: zero1_spec(s, tuple(p.shape), dp),
+        lambda s, p: zero1_spec(s, tuple(p.shape), dp, ep),
         spec_tree,
         params,
         is_leaf=lambda s: isinstance(s, P),

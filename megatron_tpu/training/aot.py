@@ -109,7 +109,8 @@ def abstract_train_inputs(model_cfg, opt_cfg, rt, global_batch: int,
         lambda: init_params(model_cfg, jax.random.PRNGKey(0)))
     state_abs = jax.eval_shape(
         lambda p: init_train_state(opt_cfg, p), params_abs)
-    state_specs = train_state_specs(specs, params_abs, rt.dp, zero1=zero1)
+    state_specs = train_state_specs(specs, params_abs, rt.dp, zero1=zero1,
+                                    ep=rt.ep)
     state_shardings = jax.tree.map(
         lambda s: NamedSharding(rt.mesh, s), state_specs,
         is_leaf=lambda s: isinstance(s, P))

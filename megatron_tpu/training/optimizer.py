@@ -78,11 +78,13 @@ def init_train_state(
 
 
 def train_state_specs(
-    param_specs: Any, params: Any, dp: int, zero1: bool,
+    param_specs: Any, params: Any, dp: int, zero1: bool, ep: int = 1,
 ) -> TrainState:
     """PartitionSpec tree shaped like TrainState. With zero1, master and
-    moments additionally shard over "data"."""
-    opt_specs = zero1_spec_tree(param_specs, params, dp) if zero1 else param_specs
+    moments additionally shard over the batch axes ("data", "expert");
+    dp is the TOTAL batch degree, ep the expert-axis size within it."""
+    opt_specs = (zero1_spec_tree(param_specs, params, dp, ep)
+                 if zero1 else param_specs)
     has_master = any(x.dtype != jnp.float32 for x in jax.tree.leaves(params))
     return TrainState(
         params=param_specs,
@@ -122,6 +124,28 @@ def _update_scaler(cfg: OptimizerConfig, s: ScalerState, found_inf) -> ScalerSta
     return ScalerState(scale=new_scale, growth_tracker=tracker, hysteresis=hy)
 
 
+def leaf_group_mults(cfg: OptimizerConfig, tree: Any):
+    """[(lr_mult, wd_mult)] per leaf of `tree`, in leaf order — the
+    path-predicate form of the reference's param groups
+    (ref: optimizer_param_scheduler.py:124-127). Static floats, resolved
+    at trace time; first matching pattern wins."""
+    import re
+
+    from jax.tree_util import tree_flatten_with_path
+
+    leaves_with_paths, _ = tree_flatten_with_path(tree)
+    out = []
+    for path, _ in leaves_with_paths:
+        name = "/".join(str(getattr(k, "key", k)) for k in path)
+        lrm = wdm = 1.0
+        for pat, l, w in cfg.param_group_mults:
+            if re.search(pat, name):
+                lrm, wdm = float(l), float(w)
+                break
+        out.append((lrm, wdm))
+    return out
+
+
 def make_optimizer_step(cfg: OptimizerConfig, train_iters: int):
     """Returns apply(state, grads) -> (new_state, metrics).
 
@@ -152,13 +176,13 @@ def make_optimizer_step(cfg: OptimizerConfig, train_iters: int):
 
         masters = state.master if state.master is not None else state.params
 
-        def adam_leaf(m, v, g, p):
+        def adam_leaf(m, v, g, p, lr_mult=1.0, wd_mult=1.0):
             m1 = b1 * m + (1 - b1) * g
             v1 = b2 * v + (1 - b2) * jnp.square(g)
             update = (m1 / bc1) / (jnp.sqrt(v1 / bc2) + cfg.adam_eps)
             if _wd_mask(p):
-                update = update + wd * p.astype(jnp.float32)
-            p1 = p.astype(jnp.float32) - lr * update
+                update = update + (wd * wd_mult) * p.astype(jnp.float32)
+            p1 = p.astype(jnp.float32) - (lr * lr_mult) * update
             return m1, v1, p1
 
         new_mu, new_nu, new_master = {}, {}, {}
@@ -167,7 +191,11 @@ def make_optimizer_step(cfg: OptimizerConfig, train_iters: int):
         nus = jax.tree.leaves(state.nu)
         gs = jax.tree.leaves(grads)
         ps = jax.tree.leaves(masters)
-        out = [adam_leaf(m, v, g, p) for m, v, g, p in zip(mus, nus, gs, ps)]
+        mults = (leaf_group_mults(cfg, masters) if cfg.param_group_mults
+                 else [(1.0, 1.0)] * len(ps))
+        out = [adam_leaf(m, v, g, p, lm, wm)
+               for (m, v, g, p), (lm, wm) in zip(zip(mus, nus, gs, ps),
+                                                 mults)]
         new_mu = jax.tree.unflatten(flat, [o[0] for o in out])
         new_nu = jax.tree.unflatten(flat, [o[1] for o in out])
         new_master = jax.tree.unflatten(flat, [o[2] for o in out])
@@ -215,8 +243,16 @@ def make_optimizer_step(cfg: OptimizerConfig, train_iters: int):
             # mu doubles as momentum buffer
             new_mu = jax.tree.map(
                 lambda m, g: cfg.sgd_momentum * m + g, state.mu, grads)
-            new_master = jax.tree.map(
-                lambda p, m: p.astype(jnp.float32) - lr * m, masters, new_mu)
+            # one update path; mults default to 1.0 everywhere (this SGD
+            # has no weight-decay term, so wd_mult has nothing to scale)
+            flat = jax.tree.structure(masters)
+            mults = (leaf_group_mults(cfg, masters) if cfg.param_group_mults
+                     else [(1.0, 1.0)] * flat.num_leaves)
+            new_master = jax.tree.unflatten(flat, [
+                p.astype(jnp.float32) - (lr * lm) * m
+                for (p, m), (lm, _) in zip(
+                    zip(jax.tree.leaves(masters), jax.tree.leaves(new_mu)),
+                    mults)])
             keep = lambda new, old: jax.tree.map(
                 lambda n, o: jnp.where(finite, n, o.astype(n.dtype)), new, old)
             new_mu = keep(new_mu, state.mu)

@@ -130,12 +130,22 @@ class TrainLoop:
 
         model_cfg = run_cfg.model
         E = model_cfg.num_experts
-        if E is not None and E % self.rt.dp:
+        if E is not None and E % self.rt.ep:
             raise ValueError(
-                f"num_experts={E} must be divisible by the data-parallel "
-                f"degree dp={self.rt.dp}: experts shard over the data axis "
-                f"(expert parallelism) — raise tensor/pipeline parallelism "
-                f"or change the expert count")
+                f"num_experts={E} must be divisible by "
+                f"expert_parallel={self.rt.ep} (experts shard over the "
+                f"dedicated expert axis; dp is unconstrained)")
+        if E is None and self.rt.ep > 1:
+            raise ValueError(
+                f"expert_parallel={self.rt.ep} set but the model has no "
+                "experts — use data_parallel instead")
+        if (E is not None and model_cfg.moe_dispatch == "dropless"
+                and self.rt.ep > 1):
+            raise ValueError(
+                "moe_dispatch='dropless' is single-expert-group only "
+                "(token counts per expert are runtime values GSPMD cannot "
+                "shard statically) — use capacity dispatch with "
+                f"expert_parallel={self.rt.ep}, or ep=1")
         self.specs = (param_specs_fn or param_specs)(model_cfg)
         params = (init_params_fn or init_params)(model_cfg, jax.random.fold_in(
             jax.random.PRNGKey(run_cfg.training.seed), 0))
@@ -157,7 +167,7 @@ class TrainLoop:
 
         zero1 = run_cfg.optimizer.use_distributed_optimizer
         self.state_specs = train_state_specs(self.specs, params, self.rt.dp,
-                                             zero1=zero1)
+                                             zero1=zero1, ep=self.rt.ep)
         self.state_shardings = jax.tree.map(
             lambda s: NamedSharding(self.rt.mesh, s), self.state_specs,
             is_leaf=lambda s: isinstance(s, P))
@@ -339,7 +349,9 @@ class TrainLoop:
 
         def put(v):
             if v.ndim == 1:  # per-sample scalars (e.g. BERT is_random)
-                sh = NamedSharding(self.rt.mesh, P("data"))
+                from megatron_tpu.parallel.sharding import BATCH_AXES
+
+                sh = NamedSharding(self.rt.mesh, P(BATCH_AXES))
             else:
                 sh = self.batch_sharding
             if multihost:

@@ -96,9 +96,13 @@ def make_t5_pipeline_loss_fn(
     stacks) and num_microbatches % num_stages == 0 (the interleaved-ring
     constraint, as in the GPT VPP schedule)."""
     Pn, M = num_stages, num_microbatches
-    L = model_cfg.num_layers
-    if L % Pn:
-        raise ValueError(f"num_layers={L} not divisible by stages {Pn}")
+    from megatron_tpu.models.t5 import t5_stack_depths
+
+    Le, Ld = t5_stack_depths(model_cfg)
+    for name, L in (("encoder", Le), ("decoder", Ld)):
+        if L % Pn:
+            raise ValueError(
+                f"{name}_num_layers={L} not divisible by stages {Pn}")
     if M % Pn:
         raise ValueError(
             f"the enc+dec interleaved ring needs num_microbatches % "

@@ -33,6 +33,10 @@ from megatron_tpu.arguments import args_to_run_config, parse_args
 def extra_args(p):
     g = p.add_argument_group("t5")
     g.add_argument("--decoder_seq_length", type=int, default=128)
+    g.add_argument("--encoder_num_layers", type=int, default=None,
+                   help="encoder depth (default: --num_layers)")
+    g.add_argument("--decoder_num_layers", type=int, default=None,
+                   help="decoder depth (default: --num_layers)")
     g.add_argument("--bos_token_id", type=int, default=101)
     g.add_argument("--eos_token_id", type=int, default=102)
     g.add_argument("--pad_token_id", type=int, default=0)
@@ -59,6 +63,8 @@ def main(argv=None):
         vocab_size=cfg.model.vocab_size,
         seq_length=cfg.model.seq_length,
         decoder_seq_length=args.decoder_seq_length,
+        encoder_num_layers=args.encoder_num_layers,
+        decoder_num_layers=args.decoder_num_layers,
         params_dtype=cfg.model.params_dtype,
     )
     cfg = dataclasses.replace(cfg, model=model)

@@ -26,7 +26,8 @@ def test_llama2_7b_dp2tp4_fits_v4_hbm():
     rep = run_scale_proof("llama2_7b_dp2tp4")  # raises MemoryError if over
     budget = SCALE_PROOFS["llama2_7b_dp2tp4"][1]
     assert rep.fits(budget), rep.summary(budget)
-    assert rep.mesh_shape == {"data": 2, "pipe": 1, "context": 1, "tensor": 4}
+    assert rep.mesh_shape == {"data": 2, "expert": 1, "pipe": 1,
+                              "context": 1, "tensor": 4}
     assert 6.5e9 < rep.n_params < 7.0e9
     # structural sanity: optimizer state + params dominate the arguments;
     # bf16 params (13.5 GB / tp4) + fp32 master+moments (80.9 GB / tp4 /
@@ -63,7 +64,7 @@ print(json.dumps({
                        text=True, timeout=1200, env=env, cwd=REPO)
     assert r.returncode == 0, r.stderr[-2000:]
     out = json.loads(r.stdout.strip().splitlines()[-1])
-    assert out["mesh_shape"] == {"data": 2, "pipe": 4, "context": 1,
-                                 "tensor": 8}
+    assert out["mesh_shape"] == {"data": 2, "expert": 1, "pipe": 4,
+                                 "context": 1, "tensor": 8}
     assert 68e9 < out["n_params"] < 70e9
     assert out["per_chip_bytes"] <= HBM_BYTES["v5p"], out["summary"]

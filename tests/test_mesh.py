@@ -33,7 +33,7 @@ def test_tensor_axis_innermost():
     rt = build_mesh(ParallelConfig(tensor_parallel=4))
     ids = np.vectorize(lambda d: d.id)(rt.mesh.devices)
     # within one tp group, device ids are consecutive
-    first_group = ids[0, 0, 0, :]
+    first_group = ids[0, 0, 0, 0, :]
     assert list(first_group) == list(range(first_group[0], first_group[0] + 4))
 
 
@@ -48,13 +48,20 @@ def test_data_parallel_mismatch():
 
 
 def test_zero1_spec():
-    # first unsharded divisible dim picks up the data axis
+    # first unsharded divisible dim picks up the batch (data+expert) axes
     s = zero1_spec(P(None, "tensor"), (64, 128), dp=4)
-    assert s == P("data", "tensor")
+    assert s == P(("data", "expert"), "tensor")
     s = zero1_spec(P("pipe", None, "tensor"), (2, 64, 128), dp=4)
-    assert s == P("pipe", "data", "tensor")
+    assert s == P("pipe", ("data", "expert"), "tensor")
     # indivisible dims stay replicated
     s = zero1_spec(P(None), (63,), dp=4)
     assert s == P(None)
     # dp=1 is a no-op
     assert zero1_spec(P(None, "tensor"), (64, 128), dp=1) == P(None, "tensor")
+    # expert-sharded MoE weights: state shards over bare data (dp/ep)
+    s = zero1_spec(P("pipe", "expert", None, "tensor"), (2, 8, 64, 128),
+                   dp=4, ep=2)
+    assert s == P("pipe", "expert", "data", "tensor")
+    # already data-sharded: unchanged
+    s = zero1_spec(P(("data", "expert"), None), (8, 64), dp=4, ep=2)
+    assert s == P(("data", "expert"), None)

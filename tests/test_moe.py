@@ -202,21 +202,185 @@ def test_moe_zero1_state_specs_valid():
     jax.block_until_ready(state.params)
 
 
-def test_moe_experts_must_divide_dp():
+def test_moe_dropless_matches_capacity_at_ample_capacity():
+    """With capacity that admits every choice, the capacity path drops
+    nothing — so the dropless sort/ragged_dot path must produce the SAME
+    outputs and aux loss (summation order differs; tolerances reflect
+    that), and the same gradients."""
+    from megatron_tpu.ops.moe import moe_block, moe_block_dropless
+
+    cfg_cap = _moe_cfg(moe_capacity_factor=8.0)  # C >= N: nothing dropped
+    cfg_drop = _moe_cfg(moe_capacity_factor=8.0, moe_dispatch="dropless")
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((2, 16, 32)).astype(np.float32))
+    p = init_params(cfg_cap, jax.random.PRNGKey(5))
+    lp = jax.tree.map(lambda a: a[0], p["layers"])
+
+    y_cap, aux_cap = moe_block(cfg_cap, lp["moe"], x)
+    y_drop, aux_drop = moe_block_dropless(cfg_drop, lp["moe"], x)
+    np.testing.assert_allclose(np.asarray(y_drop), np.asarray(y_cap),
+                               rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(float(aux_drop), float(aux_cap), rtol=1e-5)
+
+    def loss(fn, cfg, lp):
+        def f(lp):
+            y, aux = fn(cfg, lp["moe"], x)
+            return jnp.sum(jnp.square(y)) + aux
+        return jax.grad(f)(lp)
+
+    g_cap = loss(moe_block, cfg_cap, lp)
+    g_drop = loss(moe_block_dropless, cfg_drop, lp)
+    for a, b in zip(jax.tree.leaves(g_drop), jax.tree.leaves(g_cap)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-6)
+
+
+def test_moe_dropless_keeps_overflow_tokens():
+    """Where the capacity path drops tokens (tiny capacity factor), the
+    dropless path still routes them: outputs differ from the capacity
+    path exactly on dropped tokens and no token has an all-zero MLP
+    output unless its gates are zero."""
+    from megatron_tpu.ops.moe import moe_block, moe_block_dropless
+
+    # top_k=1, capacity_factor tiny: heavy experts overflow
+    cfg_cap = _moe_cfg(num_experts=2, moe_top_k=1, moe_capacity_factor=0.25,
+                       moe_renorm_gates=False)
+    cfg_drop = _moe_cfg(num_experts=2, moe_top_k=1,
+                        moe_capacity_factor=0.25, moe_renorm_gates=False,
+                        moe_dispatch="dropless")
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.standard_normal((1, 16, 32)).astype(np.float32))
+    p = init_params(cfg_cap, jax.random.PRNGKey(5))
+    lp = jax.tree.map(lambda a: a[0], p["layers"])
+
+    y_cap, _ = moe_block(cfg_cap, lp["moe"], x)
+    y_drop, _ = moe_block_dropless(cfg_drop, lp["moe"], x)
+    cap_zero = np.all(np.isclose(np.asarray(y_cap)[0], 0.0, atol=1e-7), -1)
+    drop_zero = np.all(np.isclose(np.asarray(y_drop)[0], 0.0, atol=1e-7), -1)
+    assert cap_zero.sum() > 0, "test needs actual overflow drops"
+    assert drop_zero.sum() == 0, "dropless must route every token"
+    # tokens the capacity path kept agree between the two paths
+    kept = ~cap_zero
+    np.testing.assert_allclose(np.asarray(y_drop)[0][kept],
+                               np.asarray(y_cap)[0][kept],
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_moe_dropless_exact_under_data_sharding():
+    """dropless at dp=8 (GSPMD auto-sharding of the sort/scatter) must be
+    numerically identical to the single-device path — loss AND grads."""
+    from jax.sharding import NamedSharding
+    from megatron_tpu.models.language_model import lm_loss
+    from megatron_tpu.parallel.mesh import build_mesh
+    from megatron_tpu.parallel.sharding import batch_spec, shard_tree
+
+    cfg = _moe_cfg(moe_dispatch="dropless")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    S = cfg.seq_length
+    batch = {"tokens": jnp.asarray(rng.integers(0, 96, (8, S)), jnp.int32),
+             "labels": jnp.asarray(rng.integers(0, 96, (8, S)), jnp.int32),
+             "loss_mask": jnp.ones((8, S), jnp.float32)}
+    l_ref, g_ref = jax.value_and_grad(
+        lambda p: lm_loss(cfg, p, batch)[0])(params)
+
+    rt = build_mesh(ParallelConfig())  # dp=8
+    sp = shard_tree(rt, params, param_specs(cfg))
+    sb = {k: jax.device_put(v, NamedSharding(rt.mesh, batch_spec()))
+          for k, v in batch.items()}
+    with jax.sharding.set_mesh(rt.mesh):
+        l_dp, g_dp = jax.jit(jax.value_and_grad(
+            lambda p, b: lm_loss(cfg, p, b)[0]))(sp, sb)
+    np.testing.assert_allclose(float(l_dp), float(l_ref), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(g_dp), jax.tree.leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_moe_dropless_requires_single_expert_group():
     from megatron_tpu.training.pretrain import TrainLoop
     from megatron_tpu.config import (
         OptimizerConfig, RunConfig, TrainingConfig,
     )
 
     cfg = RunConfig(
-        model=_moe_cfg(num_experts=3, moe_top_k=2),
-        parallel=ParallelConfig(tensor_parallel=2),  # dp=4, 3 % 4 != 0
+        model=_moe_cfg(num_experts=4, moe_dispatch="dropless"),
+        parallel=ParallelConfig(expert_parallel=2),
         optimizer=OptimizerConfig(lr=1e-3),
         training=TrainingConfig(micro_batch_size=1, global_batch_size=4,
-                                train_iters=1),
-    )
-    with pytest.raises(ValueError, match="divisible by the data-parallel"):
+                                train_iters=1))
+    with pytest.raises(ValueError, match="dropless"):
         TrainLoop(cfg, log=lambda s: None)
+
+
+def test_moe_experts_must_divide_ep_not_dp():
+    """EP is decoupled from dp (VERDICT r3 next-round #6): a mismatched
+    dp/experts factorization trains fine, only E % ep is constrained."""
+    from megatron_tpu.training.pretrain import TrainLoop
+    from megatron_tpu.config import (
+        OptimizerConfig, RunConfig, TrainingConfig,
+    )
+
+    def run_cfg(num_experts, parallel, gbs=4):
+        return RunConfig(
+            model=_moe_cfg(num_experts=num_experts, moe_top_k=2),
+            parallel=parallel,
+            optimizer=OptimizerConfig(lr=1e-3),
+            training=TrainingConfig(micro_batch_size=1,
+                                    global_batch_size=gbs, train_iters=1))
+
+    # 3 experts at dp=4 — illegal under the old welded-to-dp rule — now
+    # just trains (experts replicated; dp unconstrained)
+    loop = TrainLoop(run_cfg(3, ParallelConfig(tensor_parallel=2)),
+                     log=lambda s: None)
+    assert loop.rt.dp == 4 and loop.rt.ep == 1
+
+    # E % ep != 0 is the (only) constraint
+    with pytest.raises(ValueError, match="expert_parallel"):
+        TrainLoop(run_cfg(3, ParallelConfig(expert_parallel=2)),
+                  log=lambda s: None)
+
+    # ep on a dense model is a config error, not silent waste
+    cfg = RunConfig(
+        model=presets.tiny(vocab_size=64, seq_length=16),
+        parallel=ParallelConfig(expert_parallel=2),
+        optimizer=OptimizerConfig(lr=1e-3),
+        training=TrainingConfig(micro_batch_size=1, global_batch_size=4,
+                                train_iters=1))
+    with pytest.raises(ValueError, match="no\\s+experts"):
+        TrainLoop(cfg, log=lambda s: None)
+
+
+def test_moe_trains_with_dedicated_expert_axis():
+    """ep=2 x tp=2 (dp=2): expert weights shard over the expert axis,
+    tokens over (data, expert); one full TrainLoop step stays finite."""
+    from megatron_tpu.training.pretrain import TrainLoop
+    from megatron_tpu.config import (
+        OptimizerConfig, RunConfig, TrainingConfig,
+    )
+
+    cfg = RunConfig(
+        model=_moe_cfg(num_experts=4, moe_top_k=2),
+        parallel=ParallelConfig(expert_parallel=2, tensor_parallel=2),
+        optimizer=OptimizerConfig(lr=1e-3, use_distributed_optimizer=True),
+        training=TrainingConfig(micro_batch_size=1, global_batch_size=4,
+                                train_iters=2, log_interval=1),
+    )
+    logs = []
+    loop = TrainLoop(cfg, log=logs.append)
+    assert loop.rt.ep == 2 and loop.rt.dp == 4  # dp = data(2) x expert(2)
+    rng = np.random.default_rng(0)
+    S = cfg.model.seq_length
+
+    def factory(consumed, gbs):
+        while True:
+            yield {"tokens": rng.integers(0, 64, (gbs, S)).astype(np.int64),
+                   "labels": rng.integers(0, 64, (gbs, S)).astype(np.int64),
+                   "loss_mask": np.ones((gbs, S), np.float32)}
+
+    state = loop.train(factory)
+    assert int(state.step) == 2
+    assert any("lm loss" in l for l in logs)
 
 
 def test_moe_pipeline_matches_unpipelined():

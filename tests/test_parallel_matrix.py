@@ -1,64 +1,36 @@
-"""Topology matrix: one real train step through the full TrainLoop for
-every parallelism combination the 8-device fake mesh can host. The
-dp>1 x pp deadlock (round 2) showed pairwise combos can break even when
-each axis works alone — this is the standing guard against that class.
+"""Topology-matrix guard, run in its own process.
+
+The actual cases live in tests/_parallel_matrix_cases.py (not collected
+directly — the leading underscore keeps it off pytest's default glob) and
+are executed here via a fresh pytest subprocess.
+
+Why a subprocess: the matrix's 18 full-remat TrainLoop compile+execute
+cycles are where the suite's accumulated XLA:CPU process state peaks, and
+with the whole suite preceding them the process intermittently dies with a
+raw SIGABRT (no CHECK/assert message) inside a compiled step — the same
+cases pass standalone, repeatedly, and per-test jax.clear_caches() did not
+help, so the trigger is native state jax cannot free. Process isolation
+keeps the guard's full coverage while making the suite deterministic.
 """
 
-import jax
-import numpy as np
-import pytest
+import os
+import subprocess
+import sys
 
-from megatron_tpu.config import (
-    ModelConfig, OptimizerConfig, ParallelConfig, RunConfig, TrainingConfig,
-)
-from megatron_tpu.training.pretrain import TrainLoop
-
-COMBOS = {
-    "tp2_sp": dict(tensor_parallel=2, sequence_parallel=True),
-    "cp2": dict(context_parallel=2),
-    "pp2": dict(pipeline_parallel=2),
-    "pp2_vpp2": dict(pipeline_parallel=2, virtual_pipeline_parallel=2),
-    "tp2_pp2": dict(tensor_parallel=2, pipeline_parallel=2),
-    "tp2_cp2_sp": dict(tensor_parallel=2, context_parallel=2,
-                       sequence_parallel=True),
-    "tp2_pp2_cp2_sp": dict(tensor_parallel=2, pipeline_parallel=2,
-                           context_parallel=2, sequence_parallel=True),
-}
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _two_steps(parallel_kwargs, zero1, recompute, tag):
-    """Build a TrainLoop for the combo, run two steps, assert descent."""
-    par = ParallelConfig(**parallel_kwargs)
-    model = ModelConfig(num_layers=4, hidden_size=32, num_attention_heads=4,
-                        num_kv_heads=2, ffn_hidden_size=64, vocab_size=128,
-                        seq_length=32, params_dtype="float32").validate()
-    cfg = RunConfig(
-        model=model, parallel=par,
-        optimizer=OptimizerConfig(lr=1e-3, lr_decay_style="constant",
-                                  use_distributed_optimizer=zero1),
-        training=TrainingConfig(micro_batch_size=1, global_batch_size=8,
-                                train_iters=2, log_interval=1,
-                                recompute_granularity=recompute))
-    loop = TrainLoop(cfg, log=lambda s: None)
-    rng = np.random.default_rng(0)
-    batch = {"tokens": rng.integers(0, 128, (8, 32)).astype(np.int64),
-             "labels": rng.integers(0, 128, (8, 32)).astype(np.int64),
-             "loss_mask": np.ones((8, 32), np.float32)}
-    m1 = loop.train_step(batch)
-    m2 = loop.train_step(batch)
-    assert np.isfinite(float(m1["loss"])), tag
-    assert float(m2["loss"]) < float(m1["loss"]), tag
-
-
-@pytest.mark.parametrize("name", sorted(COMBOS))
-@pytest.mark.parametrize("zero1", [False, True])
-def test_train_loop_topology_matrix(name, zero1):
-    _two_steps(COMBOS[name], zero1, "full", (name, zero1))
-
-
-@pytest.mark.parametrize("recompute", ["none", "selective"])
-def test_train_loop_recompute_granularities(recompute):
-    """The other two recompute policies on a mixed mesh (the matrix above
-    runs 'full')."""
-    _two_steps(dict(tensor_parallel=2, pipeline_parallel=2), True, recompute,
-               ("tp2_pp2", recompute))
+def test_topology_matrix_in_fresh_process():
+    # start from a clean platform env; the child's pytest run loads
+    # tests/conftest.py which does force_cpu(8) as usual
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest",
+         os.path.join(REPO, "tests", "_parallel_matrix_cases.py"), "-q"],
+        capture_output=True, text=True, timeout=1800, env=env, cwd=REPO)
+    assert r.returncode == 0, (
+        f"topology matrix failed (rc={r.returncode}):\n"
+        f"{r.stdout[-3000:]}\n{r.stderr[-2000:]}")
+    assert " passed" in r.stdout

@@ -17,10 +17,11 @@ from megatron_tpu.parallel.sharding import shard_tree
 from megatron_tpu.training.t5_pipeline import make_t5_pipeline_loss_fn
 
 
-def _setup(pp, tp=1, num_layers=4, n_micro=2, mbs=2, se=16, sd=12, vocab=96):
+def _setup(pp, tp=1, num_layers=4, n_micro=2, mbs=2, se=16, sd=12, vocab=96,
+           **cfg_kw):
     cfg = t5_config(num_layers=num_layers, hidden_size=32,
                     num_attention_heads=4, vocab_size=vocab, seq_length=se,
-                    decoder_seq_length=sd, params_dtype="float32")
+                    decoder_seq_length=sd, params_dtype="float32", **cfg_kw)
     rt = build_mesh(ParallelConfig(pipeline_parallel=pp, tensor_parallel=tp))
     params = t5_init_params(cfg, jax.random.PRNGKey(0))
     params = shard_tree(rt, params, t5_param_specs(cfg))
@@ -51,6 +52,39 @@ def test_t5_pipeline_loss_matches_unpipelined(pp, tp, n_micro):
     loss_ref, _ = t5_loss(cfg, jax.device_get(params), jax.device_get(batch))
     np.testing.assert_allclose(float(loss_pp), float(loss_ref), rtol=1e-5)
     assert float(aux["ntokens"]) == batch["labels"].size
+
+
+def test_t5_asymmetric_depth_pipeline_matches_unpipelined():
+    """enc != dec depth (ref --encoder_num_layers/--decoder_num_layers) at
+    pp2: each stack chunks over stages by its own depth; loss and grads
+    must still match the unpipelined model exactly."""
+    cfg, rt, params, batch = _setup(pp=2, encoder_num_layers=6,
+                                    decoder_num_layers=2)
+    assert params["encoder"]["attn"]["wq"].shape[0] == 6
+    assert params["decoder"]["attn"]["wq"].shape[0] == 2
+    pp_loss_fn = make_t5_pipeline_loss_fn(cfg, rt.mesh, num_stages=2,
+                                          num_microbatches=2,
+                                          recompute="none")
+    with jax.sharding.set_mesh(rt.mesh):
+        loss_pp, _ = jax.jit(lambda p, b: pp_loss_fn(p, b, None))(params,
+                                                                  batch)
+        g_pp = jax.jit(jax.grad(lambda p: pp_loss_fn(p, batch, None)[0]))(
+            params)
+    host_params = jax.device_get(params)
+    loss_ref, _ = t5_loss(cfg, host_params, jax.device_get(batch))
+    np.testing.assert_allclose(float(loss_pp), float(loss_ref), rtol=1e-5)
+    g_ref = jax.grad(lambda p: t5_loss(cfg, p, jax.device_get(batch))[0])(
+        host_params)
+    for a, b in zip(jax.tree.leaves(g_pp), jax.tree.leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=1e-5)
+
+
+def test_t5_asymmetric_depth_must_divide_stages():
+    cfg, rt, _, _ = _setup(pp=2, encoder_num_layers=6, decoder_num_layers=3)
+    with pytest.raises(ValueError, match="decoder_num_layers=3"):
+        make_t5_pipeline_loss_fn(cfg, rt.mesh, num_stages=2,
+                                 num_microbatches=2)
 
 
 def test_t5_pipeline_grads_match_unpipelined():

@@ -223,6 +223,66 @@ def test_skip_iters_fault_injection(tmp_path):
     assert int(loop.state.step) == 3
 
 
+def test_per_group_lr_wd_mults():
+    """Path-pattern (lr_mult, wd_mult) groups (ref
+    optimizer_param_scheduler.py:124-127): lr_mult=0 freezes matching
+    params, wd_mult scales decay, unmatched params are untouched."""
+    from megatron_tpu.training.optimizer import (
+        init_train_state, leaf_group_mults, make_optimizer_step,
+    )
+
+    params = {"body": {"w": jnp.ones((4, 4), jnp.float32)},
+              "classification_head": {"w": jnp.ones((4, 2), jnp.float32)}}
+    grads = jax.tree.map(jnp.ones_like, params)
+
+    cfg = OptimizerConfig(
+        lr=1e-2, lr_decay_style="constant", weight_decay=0.0, clip_grad=0,
+        param_group_mults=(("classification_head", 0.0, 1.0),))
+    mults = leaf_group_mults(cfg, params)
+    assert mults == [(1.0, 1.0), (0.0, 1.0)]  # body first (dict order)
+
+    state = init_train_state(cfg, params)
+    new_state, _ = make_optimizer_step(cfg, train_iters=10)(state, grads)
+    # frozen head, moving body
+    np.testing.assert_array_equal(
+        np.asarray(new_state.params["classification_head"]["w"]),
+        np.asarray(params["classification_head"]["w"]))
+    assert not np.allclose(np.asarray(new_state.params["body"]["w"]),
+                           np.asarray(params["body"]["w"]))
+
+    # wd_mult: zero grads isolate the decay term; head decays 2x the body
+    cfg2 = OptimizerConfig(
+        lr=1e-2, lr_decay_style="constant", weight_decay=0.1, clip_grad=0,
+        param_group_mults=(("classification_head", 1.0, 2.0),))
+    zstate = init_train_state(cfg2, params)
+    zgrads = jax.tree.map(jnp.zeros_like, params)
+    ns, _ = make_optimizer_step(cfg2, train_iters=10)(zstate, zgrads)
+    body_dec = 1.0 - float(ns.params["body"]["w"][0, 0])
+    head_dec = 1.0 - float(ns.params["classification_head"]["w"][0, 0])
+    np.testing.assert_allclose(head_dec, 2 * body_dec, rtol=1e-5)
+
+
+def test_head_lr_mult_flag_builds_param_group():
+    from megatron_tpu.arguments import args_to_run_config, parse_args
+
+    args = parse_args([
+        "--num_layers", "2", "--hidden_size", "32",
+        "--num_attention_heads", "4", "--vocab_size", "64",
+        "--seq_length", "16", "--micro_batch_size", "1",
+        "--global_batch_size", "1", "--train_iters", "1", "--lr", "1e-3",
+        "--head_lr_mult", "0.1"])
+    cfg = args_to_run_config(args)
+    (pat, lrm, wdm), = cfg.optimizer.param_group_mults
+    assert "classification_head" in pat and lrm == 0.1 and wdm == 1.0
+    # default (1.0) adds no group
+    args = parse_args([
+        "--num_layers", "2", "--hidden_size", "32",
+        "--num_attention_heads", "4", "--vocab_size", "64",
+        "--seq_length", "16", "--micro_batch_size", "1",
+        "--global_batch_size", "1", "--train_iters", "1", "--lr", "1e-3"])
+    assert args_to_run_config(args).optimizer.param_group_mults == ()
+
+
 def test_timer_spans_and_writer_scalars(tmp_path):
     """The reference's span set (batch-generator / forward-backward /
     optimizer / save-checkpoint, training.py:500-525) is instrumented,
