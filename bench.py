@@ -464,6 +464,9 @@ def main():
             "detail": detail,
         }
         print(json.dumps(line), flush=True)
+        if dev.platform != "tpu":
+            # CPU sanity/test runs must not masquerade as TPU evidence
+            return
         try:
             import datetime
 
