@@ -119,9 +119,13 @@ def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
     g.add_argument("--recompute_activations", action="store_true",
                    help="ref alias for --recompute_granularity selective")
     g.add_argument("--recompute_method", default="uniform",
-                   choices=["uniform"],
-                   help="only 'uniform' (per-layer remat inside lax.scan); "
-                        "the ref's 'block' granularity has no XLA analogue")
+                   choices=["uniform", "block"],
+                   help="uniform: per-layer remat inside lax.scan; block: "
+                        "with --recompute_granularity full, remat only the "
+                        "first --recompute_num_layers layers per "
+                        "stack/pipeline-chunk (ref transformer.py:1148-1172)")
+    g.add_argument("--recompute_num_layers", type=int, default=1,
+                   help="layer budget for --recompute_method block")
     g.add_argument("--optimizer", default="adam", choices=["adam", "sgd"])
     g.add_argument("--sgd_momentum", type=float, default=0.9)
     g.add_argument("--attention_impl", default="xla",
@@ -317,6 +321,13 @@ def args_to_run_config(args) -> RunConfig:
     if getattr(args, "recompute_activations", False) \
             and args.recompute_granularity == "none":
         args.recompute_granularity = "selective"
+    if getattr(args, "recompute_method", "uniform") == "block":
+        if args.recompute_granularity != "full":
+            raise ValueError(
+                "--recompute_method block needs --recompute_granularity "
+                "full (it allocates a FULL-remat layer budget; selective "
+                "already bounds memory per layer)")
+        args.recompute_granularity = f"block:{args.recompute_num_layers}"
     if getattr(args, "log_timers_to_tensorboard", False):
         args.timing_log_level = max(args.timing_log_level, 1)
     gbs = args.global_batch_size or args.micro_batch_size

@@ -412,7 +412,10 @@ class TrainingConfig:
     data_parallel_random_init: bool = False
 
     # activation recompute (ref: transformer.py:1110-1176)
-    recompute_granularity: str = "none"  # none | selective | full
+    # none | selective | full | "block:N" (recompute only the first N
+    # layers per stack/pipeline-chunk, ref --recompute_method block +
+    # --recompute_num_layers, transformer.py:1148-1172)
+    recompute_granularity: str = "none"
 
     # checkpointing
     save: Optional[str] = None
@@ -472,8 +475,18 @@ class TrainingConfig:
         return gbs // denom
 
     def validate(self) -> "TrainingConfig":
-        if self.recompute_granularity not in RECOMPUTE_POLICIES:
-            raise ValueError(f"bad recompute_granularity {self.recompute_granularity}")
+        g = self.recompute_granularity
+        if g.startswith("block:"):
+            try:
+                ok = int(g.split(":", 1)[1]) >= 0
+            except ValueError:
+                ok = False
+            if not ok:
+                raise ValueError(
+                    f"bad recompute_granularity {g!r} — block form is "
+                    "'block:<N>' with N a non-negative layer count")
+        elif g not in RECOMPUTE_POLICIES:
+            raise ValueError(f"bad recompute_granularity {g}")
         if self.train_iters is None and self.train_samples is None:
             pass  # inference / tooling use
         return self

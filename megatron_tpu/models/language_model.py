@@ -31,10 +31,26 @@ from megatron_tpu.ops.normalization import norm_forward
 from megatron_tpu.ops.rotary import precompute_rope
 
 
+def parse_recompute(recompute: str):
+    """(granularity, block_n). "block:N" — the reference's
+    --recompute_method block + --recompute_num_layers
+    (transformer.py:1148-1172): fully recompute the first N layers of the
+    stack (or of each pipeline chunk), save the rest — "fully use the
+    device memory removing redundant re-computation". Everything else is
+    uniform per-layer policy, block_n None."""
+    if recompute and recompute.startswith("block:"):
+        n = int(recompute.split(":", 1)[1])
+        if n < 0:
+            raise ValueError(f"recompute block count must be >= 0 ({n})")
+        return "block", n
+    return recompute, None
+
+
 def _remat_policy(recompute: str):
     if recompute == "none":
         return None
-    if recompute == "full":
+    if recompute in ("full", "block"):
+        # block applies full remat to its rematted slice
         return jax.checkpoint_policies.nothing_saveable
     if recompute == "selective":
         # save weight-matmul outputs, recompute core attention — the TPU
@@ -42,6 +58,31 @@ def _remat_policy(recompute: str):
         # (transformer.py:391-410 checkpointed core attention)
         return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
     raise ValueError(f"unknown recompute policy {recompute!r}")
+
+
+def scan_with_remat(body, carry, xs, recompute: str):
+    """lax.scan over a layer stack with the configured remat policy — THE
+    single implementation for every stack (flat LM, GPT pipeline chunks,
+    T5 enc/dec slices). "block:N" splits the scan: iterations [0, N)
+    under full remat, [N, len) saved (ref --recompute_method block,
+    transformer.py:1148-1172). The block path discards scan outputs
+    (callers using ys — decode caches — never run block)."""
+    gran, block_n = parse_recompute(recompute)
+    if gran == "block":
+        length = jax.tree.leaves(xs)[0].shape[0]
+        n = min(block_n, length)
+        sl = lambda lo, hi: jax.tree.map(lambda a: a[lo:hi], xs)
+        if n > 0:
+            ck = jax.checkpoint(body, policy=_remat_policy("block"),
+                                prevent_cse=False)
+            carry, _ = jax.lax.scan(ck, carry, sl(0, n))
+        if n < length:
+            carry, _ = jax.lax.scan(body, carry, sl(n, length))
+        return carry, None
+    policy = _remat_policy(gran)
+    if policy is not None:
+        body = jax.checkpoint(body, policy=policy, prevent_cse=False)
+    return jax.lax.scan(body, carry, xs)
 
 
 def _layer_dropout_rates(cfg: ModelConfig) -> jnp.ndarray:
@@ -153,14 +194,12 @@ def lm_forward(
         )
         return (y, aux + moe_aux), new_cache
 
-    policy = _remat_policy(recompute)
-    if policy is not None:
-        body = jax.checkpoint(body, policy=policy, prevent_cse=False)
-
     layer_idx = jnp.arange(cfg.num_layers)
     xs = (params["layers"], rates, layer_idx, kv_caches)
-    (x, moe_aux), new_caches = jax.lax.scan(
-        body, (x, jnp.zeros((), jnp.float32)), xs)
+    if kv_caches is not None and parse_recompute(recompute)[1] is not None:
+        recompute = "none"  # decode path: caches preclude the split scan
+    (x, moe_aux), new_caches = scan_with_remat(
+        body, (x, jnp.zeros((), jnp.float32)), xs, recompute)
 
     x = final_hidden_norm(cfg, params, x)
     if return_hidden:

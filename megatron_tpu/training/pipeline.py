@@ -49,7 +49,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from megatron_tpu.config import ModelConfig
 from megatron_tpu.models.language_model import (
     _dropout, _layer_dropout_rates, chunked_lm_loss_tokens,
-    final_hidden_norm, lm_logits, _remat_policy,
+    final_hidden_norm, lm_logits, scan_with_remat,
 )
 from megatron_tpu.models.transformer import block_forward
 from megatron_tpu.ops.cross_entropy import cross_entropy_loss
@@ -116,11 +116,11 @@ def _stage_fn(cfg: ModelConfig, chunk_layers: Any, x: jnp.ndarray,
                                       **({"sharder": sharder} if sharder else {}))
         return (y, aux + moe_aux), None
 
-    policy = _remat_policy(recompute)
-    if policy is not None:
-        body = jax.checkpoint(body, policy=policy, prevent_cse=False)
-    (x, aux), _ = jax.lax.scan(body, (x, jnp.zeros((), jnp.float32)),
-                               (chunk_layers, jnp.arange(layers_per_chunk)))
+    # block:N remats only the first N of this chunk's layers (the
+    # reference applies the budget per pipeline stage)
+    (x, aux), _ = scan_with_remat(
+        body, (x, jnp.zeros((), jnp.float32)),
+        (chunk_layers, jnp.arange(layers_per_chunk)), recompute)
     return x, aux
 
 

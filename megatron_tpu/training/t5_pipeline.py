@@ -42,7 +42,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from megatron_tpu.config import ModelConfig
-from megatron_tpu.models.language_model import _remat_policy
+from megatron_tpu.models.language_model import scan_with_remat
 from megatron_tpu.models.t5 import _attn, _mlp, _norm
 from megatron_tpu.ops.cross_entropy import cross_entropy_loss
 from megatron_tpu.training.pipeline import _embed_onehot
@@ -57,10 +57,7 @@ def _enc_stack(cfg, layers, x, padding_mask, recompute):
         h = h + _mlp(cfg, lp["mlp"], _norm(cfg, lp["ln2"], h))
         return h, None
 
-    policy = _remat_policy(recompute)
-    if policy is not None:
-        body = jax.checkpoint(body, policy=policy, prevent_cse=False)
-    x, _ = jax.lax.scan(body, x, layers)
+    x, _ = scan_with_remat(body, x, layers, recompute)
     return x
 
 
@@ -75,10 +72,7 @@ def _dec_stack(cfg, layers, y, enc_out, enc_padding_mask, recompute):
         h = h + _mlp(cfg, lp["mlp"], _norm(cfg, lp["ln2"], h))
         return h, None
 
-    policy = _remat_policy(recompute)
-    if policy is not None:
-        body = jax.checkpoint(body, policy=policy, prevent_cse=False)
-    y, _ = jax.lax.scan(body, y, layers)
+    y, _ = scan_with_remat(body, y, layers, recompute)
     return y
 
 

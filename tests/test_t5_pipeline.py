@@ -80,6 +80,20 @@ def test_t5_asymmetric_depth_pipeline_matches_unpipelined():
                                    rtol=5e-4, atol=1e-5)
 
 
+def test_t5_pipeline_block_recompute_matches_unpipelined():
+    """block:N remat flows through the enc+dec ring too (was a crash —
+    the stacks passed the raw 'block:N' string to the policy lookup)."""
+    cfg, rt, params, batch = _setup(pp=2)
+    pp_loss_fn = make_t5_pipeline_loss_fn(cfg, rt.mesh, num_stages=2,
+                                          num_microbatches=2,
+                                          recompute="block:1")
+    with jax.sharding.set_mesh(rt.mesh):
+        loss_pp, _ = jax.jit(lambda p, b: pp_loss_fn(p, b, None))(params,
+                                                                  batch)
+    loss_ref, _ = t5_loss(cfg, jax.device_get(params), jax.device_get(batch))
+    np.testing.assert_allclose(float(loss_pp), float(loss_ref), rtol=1e-5)
+
+
 def test_t5_asymmetric_depth_must_divide_stages():
     cfg, rt, _, _ = _setup(pp=2, encoder_num_layers=6, decoder_num_layers=3)
     with pytest.raises(ValueError, match="decoder_num_layers=3"):
