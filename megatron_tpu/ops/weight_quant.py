@@ -1,18 +1,23 @@
-"""int8 weight-only quantization for serving (beyond the reference).
+"""int8 / fp8 weight-only quantization for serving (beyond the reference;
+the fp8 form is this stack's answer to the reference's optional
+TransformerEngine fp8 path, megatron/model/transformer.py:962-1043 —
+serving-side only; fp8 *training* remains out of scope).
 
-Halves parameter HBM so models that don't fit in bf16 serve on one chip
-(Llama-2-7B: 14 GB bf16 vs ~7 GB int8 on a 16 GB v5e, leaving room for
-the KV cache — pair with the int8 KV cache in ops/kv_quant.py). Matmul
-weights get symmetric per-output-channel scales; the embedding gets
-per-row scales (one scale serves both the gather and the tied-logits
-matmul since both index/reduce the same way). Dequantization happens
-inside the step — under the layer scan only one layer's weights are ever
+Both halve parameter HBM so models that don't fit in bf16 serve on one
+chip (Llama-2-7B: 14 GB bf16 vs ~7 GB quantized on a 16 GB v5e, leaving
+room for the KV cache — pair with the int8 KV cache in ops/kv_quant.py).
+Matmul weights get symmetric per-output-channel scales; the embedding
+gets per-row scales (one scale serves both the gather and the tied-logits
+matmul since both index/reduce the same way). int8 uses a uniform grid;
+fp8 (e4m3, amax mapped to its 448 max) spends its bits log-wise, which
+suits heavy-tailed weight distributions. Dequantization happens inside
+the step — under the layer scan only one layer's weights are ever
 resident in bf16 — and feeds the unchanged einsums; biases, norms and
 small embeddings stay in the original dtype.
 
 Serving-only: quantized trees are for inference (no gradient path) and,
-in v1, unsharded single-chip serving (the {q8, s} leaves change the tree
-structure that param_specs mirrors).
+in v1, unsharded single-chip serving (the {q8|f8, s} leaves change the
+tree structure that param_specs mirrors).
 """
 
 from __future__ import annotations
@@ -23,6 +28,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from megatron_tpu.ops.kv_quant import symmetric_int8
+
+_F8_MAX = 448.0  # float8_e4m3fn finite max
 
 # (parent key, weight key) pairs quantized per-output-channel; scoping by
 # parent keeps MoE experts and task heads (whose use sites have no dequant
@@ -50,38 +57,73 @@ def quantize_rows(w) -> Dict[str, np.ndarray]:
     return {"q8": q, "s": s}
 
 
+def _fp8_quantize(w: np.ndarray, axis: int) -> Dict[str, np.ndarray]:
+    """Symmetric per-channel fp8(e4m3): scale maps the channel amax to
+    the format max; stored 1 byte/weight like int8."""
+    w = np.asarray(w, np.float32)
+    amax = np.max(np.abs(w), axis=axis, keepdims=True)
+    s = np.maximum(amax, 1e-12) / _F8_MAX
+    # pure-numpy cast (jnp.float8_e4m3fn is an ml_dtypes dtype): this must
+    # NOT touch the device — the whole point is quantizing a tree that
+    # barely fits HBM without a second device copy
+    f8 = (w / s).astype(jnp.float8_e4m3fn)
+    return {"f8": f8, "s": s.astype(np.float32)}
+
+
+def quantize_linear_fp8(w) -> Dict[str, np.ndarray]:
+    """[..., in, out] -> {"f8", "s": [..., 1, out]} (host-side, like
+    quantize_linear)."""
+    return _fp8_quantize(w, axis=-2)
+
+
+def quantize_rows_fp8(w) -> Dict[str, np.ndarray]:
+    """[V, h] embedding -> {"f8", "s": [V, 1]}."""
+    return _fp8_quantize(w, axis=-1)
+
+
 def is_quantized(w: Any) -> bool:
-    return isinstance(w, dict) and "q8" in w
+    return isinstance(w, dict) and ("q8" in w or "f8" in w)
+
+
+def _payload(w: Dict[str, Any]):
+    return w["q8"] if "q8" in w else w["f8"]
 
 
 def deq(w: Any, dtype) -> jnp.ndarray:
-    """Dequantize a {q8, s} leaf (or pass a plain array through)."""
+    """Dequantize a {q8|f8, s} leaf (or pass a plain array through)."""
     if is_quantized(w):
-        return (w["q8"].astype(jnp.float32) * w["s"]).astype(dtype)
+        return (_payload(w).astype(jnp.float32) * w["s"]).astype(dtype)
     return w
 
 
 def take_rows(w: Any, ids: jnp.ndarray, dtype) -> jnp.ndarray:
     """Embedding gather that dequantizes only the gathered rows."""
     if is_quantized(w):
-        rows = jnp.take(w["q8"], ids, axis=0).astype(jnp.float32)
+        rows = jnp.take(_payload(w), ids, axis=0).astype(jnp.float32)
         scales = jnp.take(w["s"], ids, axis=0)
         return (rows * scales).astype(dtype)
     return jnp.take(w, ids, axis=0)
 
 
-def quantize_params_for_serving(params: Dict[str, Any]) -> Dict[str, Any]:
+def quantize_params_for_serving(params: Dict[str, Any],
+                                mode: str = "int8") -> Dict[str, Any]:
     """Walk a (possibly stacked-layers) param tree and quantize the matmul
-    weights + token embedding; everything else passes through unchanged."""
+    weights + token embedding; everything else passes through unchanged.
+    mode: "int8" (uniform grid) or "fp8" (e4m3 log grid)."""
+    if mode not in ("int8", "fp8"):
+        raise ValueError(f"unknown weight quant mode {mode!r}")
+    q_linear = quantize_linear if mode == "int8" else quantize_linear_fp8
+    q_rows = quantize_rows if mode == "int8" else quantize_rows_fp8
+
     def walk(node, name=None):
         if isinstance(node, dict):
             out = {}
             for k, v in node.items():
                 if k == "tokens" and name == "embed":
-                    out[k] = quantize_rows(v)
+                    out[k] = q_rows(v)
                 elif ((name, k) in _LINEAR_SITES and not isinstance(v, dict)
                       and getattr(v, "ndim", 0) >= 2):
-                    out[k] = quantize_linear(v)
+                    out[k] = q_linear(v)
                 else:
                     out[k] = walk(v, k)
             return out

@@ -84,3 +84,46 @@ def test_tied_embedding_quantized_logits():
         lm_forward(cfg, quantize_params_for_serving(params), toks),
         np.float32)
     assert np.abs(got - ref).max() / np.abs(ref).max() < 0.1
+
+
+def test_fp8_quantize_and_forward_tracks_full_precision():
+    """fp8(e4m3) weight-only mode: same tree shape and 1 byte/weight as
+    int8, log-grid error bound (relative ~2^-3 per weight), and the
+    quantized forward tracks full precision at least as well as int8."""
+    from megatron_tpu.ops.weight_quant import quantize_linear_fp8
+
+    rng = np.random.default_rng(5)
+    w = jnp.asarray(rng.normal(0, 0.02, (2, 64, 32)), jnp.float32)
+    qd = quantize_linear_fp8(w)
+    assert qd["f8"].dtype == jnp.float8_e4m3fn
+    assert qd["s"].shape == (2, 1, 32)
+    assert qd["f8"].nbytes == np.asarray(w).nbytes // 4
+    back = np.asarray(deq(qd, jnp.float32))
+    # e4m3 has a 3-bit mantissa: relative error <= 2^-4 of each value
+    # (plus the scale floor for near-zero weights)
+    err = np.abs(back - np.asarray(w))
+    tol = np.abs(np.asarray(w)) * 2.0 ** -3 + np.asarray(qd["s"]) * 2.0 ** -6
+    assert (err <= tol + 1e-8).all()
+
+    toks = jnp.asarray(rng.integers(0, 128, (2, 16)), jnp.int32)
+    ref = np.asarray(lm_forward(CFG, PARAMS, toks), np.float32)
+    got = np.asarray(
+        lm_forward(CFG, quantize_params_for_serving(PARAMS, mode="fp8"),
+                   toks), np.float32)
+    assert np.abs(got - ref).max() / np.abs(ref).max() < 0.1
+    agree = (ref.argmax(-1) == got.argmax(-1)).mean()
+    assert agree >= 0.85
+
+
+def test_fp8_generation_end_to_end():
+    from megatron_tpu.inference.generation import generate_tokens
+
+    rng = np.random.default_rng(6)
+    prompts = rng.integers(1, 128, (2, 6)).astype(np.int32)
+    lengths = np.array([6, 5], np.int32)
+    qparams = quantize_params_for_serving(PARAMS, mode="fp8")
+    out = generate_tokens(CFG, qparams, prompts, lengths, max_new_tokens=6,
+                          temperature=0.0, top_k=1, seed=0,
+                          want_logprobs=False)
+    assert out.tokens.shape == (2, 12)
+    np.testing.assert_array_equal(out.tokens[0, :6], prompts[0])

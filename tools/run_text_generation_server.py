@@ -29,6 +29,11 @@ def extra_args(parser):
                    help="int8 weight-only quantization at load: half the "
                         "param HBM (7B fits one 16GB chip); single-chip "
                         "serving only")
+    g.add_argument("--weight_fp8", action="store_true",
+                   help="fp8(e4m3) weight-only quantization at load: same "
+                        "1 byte/weight as int8 with a log-wise grid "
+                        "(better for heavy-tailed weights); single-chip "
+                        "serving only")
     return parser
 
 
@@ -64,21 +69,25 @@ def main(argv=None):
     par = cfg.parallel
     sharded = (par.tensor_parallel * par.pipeline_parallel
                * par.context_parallel > 1)
-    if args.weight_int8:
+    if args.weight_int8 and args.weight_fp8:
+        raise SystemExit("--weight_int8 and --weight_fp8 are exclusive")
+    if args.weight_int8 or args.weight_fp8:
+        mode = "int8" if args.weight_int8 else "fp8"
         if sharded:
             raise SystemExit(
-                "--weight_int8 is single-chip serving only in v1 (the "
-                "quantized {q8, s} leaves change the tree that the sharding "
+                f"--weight_{mode} is single-chip serving only in v1 (the "
+                "quantized leaves change the tree that the sharding "
                 "specs mirror); drop one of the two flags")
         if cfg.model.num_experts is not None:
             raise SystemExit(
-                "--weight_int8 does not cover MoE expert weights in v1 — "
+                f"--weight_{mode} does not cover MoE expert weights in v1 — "
                 "the bulk of a MoE model's params would stay bf16 while "
                 "the flag promises halved HBM; serve MoE without it")
         from megatron_tpu.ops.weight_quant import quantize_params_for_serving
 
-        params = quantize_params_for_serving(params)
-        print("serving int8-quantized weights (matmul + embedding tables)")
+        params = quantize_params_for_serving(params, mode=mode)
+        print(f"serving {mode}-quantized weights (matmul + embedding "
+              "tables)")
     if sharded:
         from megatron_tpu.inference.pipelined import make_pipelined_lm_forward
         from megatron_tpu.models.params import param_specs
