@@ -120,12 +120,13 @@ def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
                    help="ref alias for --recompute_granularity selective")
     g.add_argument("--recompute_method", default="uniform",
                    choices=["uniform", "block"],
-                   help="uniform: per-layer remat inside lax.scan; block: "
-                        "with --recompute_granularity full, remat only the "
-                        "first --recompute_num_layers layers per "
-                        "stack/pipeline-chunk (ref transformer.py:1148-1172)")
+                   help="with --recompute_granularity full: uniform remats "
+                        "in chunks of --recompute_num_layers (sqrt-remat "
+                        "carry storage when N ~ sqrt(L)); block remats only "
+                        "the first N layers per stack/pipeline-chunk "
+                        "(ref transformer.py:1110-1172)")
     g.add_argument("--recompute_num_layers", type=int, default=1,
-                   help="layer budget for --recompute_method block")
+                   help="layer budget/chunk for --recompute_method")
     g.add_argument("--optimizer", default="adam", choices=["adam", "sgd"])
     g.add_argument("--sgd_momentum", type=float, default=0.9)
     g.add_argument("--attention_impl", default="xla",
@@ -321,13 +322,16 @@ def args_to_run_config(args) -> RunConfig:
     if getattr(args, "recompute_activations", False) \
             and args.recompute_granularity == "none":
         args.recompute_granularity = "selective"
-    if getattr(args, "recompute_method", "uniform") == "block":
+    method = getattr(args, "recompute_method", "uniform")
+    n_rc = getattr(args, "recompute_num_layers", 1)
+    if method == "block" or (method == "uniform" and n_rc > 1):
         if args.recompute_granularity != "full":
             raise ValueError(
-                "--recompute_method block needs --recompute_granularity "
-                "full (it allocates a FULL-remat layer budget; selective "
-                "already bounds memory per layer)")
-        args.recompute_granularity = f"block:{args.recompute_num_layers}"
+                f"--recompute_method {method} with --recompute_num_layers "
+                "needs --recompute_granularity full (they allocate a "
+                "FULL-remat layer budget; selective already bounds memory "
+                "per layer)")
+        args.recompute_granularity = f"{method}:{n_rc}"
     if getattr(args, "log_timers_to_tensorboard", False):
         args.timing_log_level = max(args.timing_log_level, 1)
     gbs = args.global_batch_size or args.micro_batch_size

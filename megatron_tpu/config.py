@@ -412,9 +412,10 @@ class TrainingConfig:
     data_parallel_random_init: bool = False
 
     # activation recompute (ref: transformer.py:1110-1176)
-    # none | selective | full | "block:N" (recompute only the first N
-    # layers per stack/pipeline-chunk, ref --recompute_method block +
-    # --recompute_num_layers, transformer.py:1148-1172)
+    # none | selective | full | "block:N" (remat only the first N layers
+    # per stack/pipeline-chunk) | "uniform:N" (chunked two-level remat,
+    # sqrt-remat carry storage) — ref --recompute_method +
+    # --recompute_num_layers, transformer.py:1110-1172
     recompute_granularity: str = "none"
 
     # checkpointing
@@ -476,15 +477,19 @@ class TrainingConfig:
 
     def validate(self) -> "TrainingConfig":
         g = self.recompute_granularity
-        if g.startswith("block:"):
+        if g.startswith(("block:", "uniform:")):
+            kind = g.split(":", 1)[0]
             try:
-                ok = int(g.split(":", 1)[1]) >= 0
+                n = int(g.split(":", 1)[1])
+                ok = n >= (1 if kind == "uniform" else 0)
             except ValueError:
                 ok = False
             if not ok:
                 raise ValueError(
-                    f"bad recompute_granularity {g!r} — block form is "
-                    "'block:<N>' with N a non-negative layer count")
+                    f"bad recompute_granularity {g!r} — form is "
+                    f"'{kind}:<N>' with N a "
+                    + ("positive chunk size" if kind == "uniform"
+                       else "non-negative layer count"))
         elif g not in RECOMPUTE_POLICIES:
             raise ValueError(f"bad recompute_granularity {g}")
         if self.train_iters is None and self.train_samples is None:

@@ -32,18 +32,38 @@ from megatron_tpu.ops.rotary import precompute_rope
 
 
 def parse_recompute(recompute: str):
-    """(granularity, block_n). "block:N" — the reference's
-    --recompute_method block + --recompute_num_layers
-    (transformer.py:1148-1172): fully recompute the first N layers of the
-    stack (or of each pipeline chunk), save the rest — "fully use the
-    device memory removing redundant re-computation". Everything else is
-    uniform per-layer policy, block_n None."""
-    if recompute and recompute.startswith("block:"):
-        n = int(recompute.split(":", 1)[1])
-        if n < 0:
-            raise ValueError(f"recompute block count must be >= 0 ({n})")
-        return "block", n
+    """(granularity, n) for the reference's --recompute_method +
+    --recompute_num_layers pair (transformer.py:1110-1172):
+
+    * "block:N"   — fully recompute the first N layers of the stack (or
+      of each pipeline chunk), save the rest ("fully use the device
+      memory removing redundant re-computation").
+    * "uniform:N" — checkpoint chunk BOUNDARIES every N layers: the scan
+      runs as outer-chunks x inner-layers with BOTH levels rematted,
+      storing L/N + N residual-stream carries instead of L (sqrt-remat at
+      N ~ sqrt(L); "full" is uniform:1) at the cost of recomputing each
+      layer twice. The carry saving pays at depth/batch scale — at toy
+      test geometries other transients dominate the measurement.
+
+    Everything else is a per-layer policy name, n None."""
+    for prefix in ("block", "uniform"):
+        if recompute and recompute.startswith(prefix + ":"):
+            n = int(recompute.split(":", 1)[1])
+            if n <= 0 and prefix == "uniform":
+                raise ValueError(f"uniform chunk must be >= 1 ({n})")
+            if n < 0:
+                raise ValueError(f"recompute layer count must be >= 0 ({n})")
+            return prefix, n
     return recompute, None
+
+
+def is_full_remat_family(recompute: str) -> bool:
+    """full / block:N / uniform:N — the memory-pressure policies whose
+    pipeline tick scans should also be segment-rematted (there the live
+    tick carries dominate, and a user choosing aggressive recompute must
+    not silently get MORE live memory than plain 'full' would)."""
+    gran, _ = parse_recompute(recompute)
+    return gran in ("full", "block", "uniform")
 
 
 def _remat_policy(recompute: str):
@@ -79,6 +99,35 @@ def scan_with_remat(body, carry, xs, recompute: str):
         if n < length:
             carry, _ = jax.lax.scan(body, carry, sl(n, length))
         return carry, None
+    if gran == "uniform" and block_n > 1:
+        length = jax.tree.leaves(xs)[0].shape[0]
+        n = block_n
+        if length % n:
+            raise ValueError(
+                f"uniform:{n} needs the layer count ({length}) divisible "
+                "by the chunk size (per pipeline chunk when pp > 1)")
+
+        # BOTH levels rematted (classic sqrt-remat): the outer backward
+        # stores L/N chunk carries; replaying a chunk stores N per-layer
+        # carries because the inner body is itself rematted — without the
+        # inner remat each replayed chunk would save N full layers'
+        # internals and chunking would COST memory (measured 254 MB at
+        # uniform:2 vs 101 MB plain full before this line existed)
+        inner = jax.checkpoint(body, policy=_remat_policy("full"),
+                               prevent_cse=False)
+
+        def chunk_body(c, chunk_xs):
+            c, _ = jax.lax.scan(inner, c, chunk_xs)
+            return c, None
+
+        ck = jax.checkpoint(chunk_body, policy=_remat_policy("full"),
+                            prevent_cse=False)
+        xs2 = jax.tree.map(
+            lambda a: a.reshape((length // n, n) + a.shape[1:]), xs)
+        carry, _ = jax.lax.scan(ck, carry, xs2)
+        return carry, None
+    if gran == "uniform":
+        gran = "full"  # uniform:1 == per-layer full remat
     policy = _remat_policy(gran)
     if policy is not None:
         body = jax.checkpoint(body, policy=policy, prevent_cse=False)

@@ -27,7 +27,9 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from megatron_tpu.config import RunConfig
-from megatron_tpu.models.language_model import lm_loss
+from megatron_tpu.models.language_model import (
+    is_full_remat_family, lm_loss,
+)
 from megatron_tpu.models.params import init_params, param_specs
 from megatron_tpu.parallel.mesh import MeshRuntime, build_mesh
 from megatron_tpu.parallel.sharding import (
@@ -299,7 +301,7 @@ class TrainLoop:
                     # full recompute = the memory-pressure regime: also
                     # segment the tick scan so live carries stay at the
                     # 1F1B-like ~2*pp bound instead of one per tick
-                    remat_segment=pp if recompute == "full" else None,
+                    remat_segment=pp if is_full_remat_family(recompute) else None,
                     # the state stores layers in placed order (see __init__)
                     layers_placed=self._vpp_perms is not None)
             step = make_train_step(

@@ -42,7 +42,9 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from megatron_tpu.config import ModelConfig
-from megatron_tpu.models.language_model import scan_with_remat
+from megatron_tpu.models.language_model import (
+    is_full_remat_family, scan_with_remat,
+)
 from megatron_tpu.models.t5 import _attn, _mlp, _norm
 from megatron_tpu.ops.cross_entropy import cross_entropy_loss
 from megatron_tpu.training.pipeline import _embed_onehot
@@ -105,7 +107,7 @@ def make_t5_pipeline_loss_fn(
     # full recompute is the memory-pressure regime: segment the tick scan
     # (as the GPT pipeline does) so backward live carries stay ~2*Pn pairs
     # instead of one (hidden, enc_out) pair per tick
-    seg = Pn if recompute == "full" else None
+    seg = Pn if is_full_remat_family(recompute) else None
 
     def loss_fn(params: Dict[str, Any], batch: Dict[str, jnp.ndarray],
                 dropout_key: Optional[jax.Array] = None):

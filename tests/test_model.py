@@ -89,7 +89,7 @@ def test_recompute_policies_agree():
         return f
 
     g_none = jax.grad(loss_fn("none"))(params)
-    for rec in ("full", "selective", "block:1", "block:2"):
+    for rec in ("full", "selective", "block:1", "block:2", "uniform:2"):
         g = jax.grad(loss_fn(rec))(params)
         for a, b in zip(jax.tree.leaves(g_none), jax.tree.leaves(g)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),

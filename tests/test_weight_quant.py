@@ -99,8 +99,9 @@ def test_fp8_quantize_and_forward_tracks_full_precision():
     assert qd["s"].shape == (2, 1, 32)
     assert qd["f8"].nbytes == np.asarray(w).nbytes // 4
     back = np.asarray(deq(qd, jnp.float32))
-    # e4m3 has a 3-bit mantissa: relative error <= 2^-4 of each value
-    # (plus the scale floor for near-zero weights)
+    # e4m3's 3-bit mantissa gives relative error <= 2^-4 at
+    # round-to-nearest; assert the looser 2^-3 so the bound is robust to
+    # rounding-mode details (plus the scale floor for near-zero weights)
     err = np.abs(back - np.asarray(w))
     tol = np.abs(np.asarray(w)) * 2.0 ** -3 + np.asarray(qd["s"]) * 2.0 ** -6
     assert (err <= tol + 1e-8).all()
