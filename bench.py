@@ -220,22 +220,33 @@ def largest_candidates():
 
 def largest_trainable_bench(deadline, peak):
     """Largest on-chip-trainable llama geometry + its MFU, or an error
-    record. Descending search; per-geometry mbs 2 then 1, always with
-    chunked CE + selective recompute (the memory-optimal settings)."""
+    record. Descending search; per-geometry (mbs, recompute) tiers from
+    fastest to most memory-frugal — chunked CE throughout, selective
+    first, then full and sqrt-remat (uniform:N) which trade step time for
+    fitting a bigger model (the metric here is SIZE, not MFU)."""
     from megatron_tpu.models.params import num_params
 
     for cfg in largest_candidates():
         ce_chunk = 512 if cfg.seq_length % 512 == 0 else 0
-        for mbs in (2, 1):
+        # sqrt-remat chunk must DIVIDE the layer count (scan_with_remat
+        # raises otherwise — and that ValueError is not an OOM, it would
+        # abort the whole search): nearest divisor of L to sqrt(L), >1
+        L = cfg.num_layers
+        divs = [d for d in range(2, L + 1) if L % d == 0]
+        chunk = min(divs, key=lambda d: abs(d - L ** 0.5)) if divs else 1
+        tiers = [(2, "selective"), (1, "selective"), (1, "full")]
+        if chunk > 1:
+            tiers.append((1, f"uniform:{chunk}"))
+        for mbs, gran in tiers:
             if deadline - time.perf_counter() < 45:
                 return {"error": "budget_exhausted"}
             try:
-                dt, loss = _measure(cfg, mbs, "selective", ce_chunk, iters=3)
+                dt, loss = _measure(cfg, mbs, gran, ce_chunk, iters=3)
             except Exception as e:
                 if not is_oom(e):
                     return {"error": str(e)[:300]}
                 print(f"# largest: h={cfg.hidden_size} L={cfg.num_layers} "
-                      f"mbs={mbs} OOM", file=sys.stderr)
+                      f"mbs={mbs} {gran} OOM", file=sys.stderr)
                 continue
             n = num_params(cfg)
             tps = mbs * cfg.seq_length / dt
@@ -244,6 +255,7 @@ def largest_trainable_bench(deadline, peak):
                 "n_params": n,
                 "hidden": cfg.hidden_size, "layers": cfg.num_layers,
                 "micro_bs": mbs, "seq": cfg.seq_length,
+                "recompute": gran,
                 "mfu": round(mfu, 4),
                 "tokens_per_sec_per_chip": round(tps),
                 "step_ms": round(dt * 1e3, 2), "loss": loss,
