@@ -44,11 +44,18 @@ def _interpret() -> bool:
 
 
 def _block_mask(qi, ki, causal: bool, window: Optional[int],
-                block_q: int, block_k: int):
+                block_q: int, block_k: int, delta=0):
     """[BQ, BK] bool mask from 2-D iotas (1-D iota lowers to scalar code on
-    TPU — keep everything 2-D)."""
+    TPU — keep everything 2-D).
+
+    delta (may be a traced scalar, e.g. an SMEM value): global-position
+    offset q_global - k_global of the two tiles' origins. The ring
+    attention path uses it so ONE kernel covers every stripe pair —
+    aligned-diagonal (delta 0), fully-past (delta >= kv length) and
+    shifted sliding-window bands — without per-case kernel variants."""
     qq = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
     kk = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    qq = qq + delta
     m = jnp.ones((block_q, block_k), dtype=jnp.bool_)
     if causal:
         m &= kk <= qq
@@ -62,7 +69,7 @@ def _block_mask(qi, ki, causal: bool, window: Optional[int],
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+def _fwd_kernel(delta_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr,
                 *, scale: float, causal: bool, window: Optional[int],
                 block_q: int, block_k: int):
@@ -80,7 +87,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     k = k_ref[0, 0].astype(jnp.float32)             # [BK, D]
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [BQ, BK]
 
-    mask = _block_mask(qi, ki, causal, window, block_q, block_k)
+    mask = _block_mask(qi, ki, causal, window, block_q, block_k,
+                       delta_ref[0])
     s = jnp.where(mask, s, _NEG_INF)
 
     m_prev = m_scr[:]                                # [BQ, 1]
@@ -106,9 +114,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                                          lse_ref.shape[2:])
 
 
-def _fwd(q, k, v, scale, causal, window, block_q, block_k):
+def _delta_arr(delta):
+    """Scalar global-position offset -> [1] int32 SMEM operand."""
+    if delta is None:
+        return jnp.zeros((1,), jnp.int32)
+    return jnp.asarray(delta, jnp.int32).reshape(1)
+
+
+def _fwd(q, k, v, scale, causal, window, block_q, block_k, delta=None):
     """q [B,Hq,Sq,D], k/v [B,Hq,Skv,D] (kv already group-broadcast).
-    Returns (o [B,Hq,Sq,D], lse [B,Hq,Sq])."""
+    Returns (o [B,Hq,Sq,D], lse [B,Hq,Sq]). delta: traced q-vs-k global
+    position offset (ring stripes); None = aligned."""
     B, H, Sq, D = q.shape
     Skv = k.shape[2]
     grid = (B, H, Sq // block_q, Skv // block_k)
@@ -120,6 +136,7 @@ def _fwd(q, k, v, scale, causal, window, block_q, block_k):
         kernel,
         grid=grid,
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, 0)),
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, 0)),
@@ -141,7 +158,7 @@ def _fwd(q, k, v, scale, causal, window, block_q, block_k):
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=_interpret(),
-    )(q, k, v)
+    )(_delta_arr(delta), q, k, v)
     return o, lse
 
 
@@ -150,8 +167,8 @@ def _fwd(q, k, v, scale, causal, window, block_q, block_k):
 # ---------------------------------------------------------------------------
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_scr,
+def _dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, dq_scr,
                *, scale: float, causal: bool, window: Optional[int],
                block_q: int, block_k: int):
     qi = pl.program_id(2)
@@ -170,7 +187,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     delta = delta_ref[0, 0][:, 0:1]                  # [BQ, 1]
 
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))
-    mask = _block_mask(qi, ki, causal, window, block_q, block_k)
+    mask = _block_mask(qi, ki, causal, window, block_q, block_k, off_ref[0])
     p = jnp.where(mask, jnp.exp(s - lse), 0.0)       # softmax probs
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))  # [BQ, BK]
     ds = p * (dp - delta)
@@ -181,7 +198,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr,
                 *, scale: float, causal: bool, window: Optional[int],
                 block_q: int, block_k: int):
@@ -202,7 +219,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     delta = delta_ref[0, 0][:, 0:1]
 
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))
-    mask = _block_mask(qi, ki, causal, window, block_q, block_k)
+    mask = _block_mask(qi, ki, causal, window, block_q, block_k, off_ref[0])
     p = jnp.where(mask, jnp.exp(s - lse), 0.0)       # [BQ, BK]
     dv_scr[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())))
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))
@@ -216,12 +233,14 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd(q, k, v, o, lse, do, scale, causal, window, block_q, block_k):
+def _bwd(q, k, v, o, lse, do, scale, causal, window, block_q, block_k,
+         offset=None):
     B, H, Sq, D = q.shape
     Skv = k.shape[2]
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
                     keepdims=True)  # [B,H,Sq,1]
     delta = jnp.broadcast_to(delta, delta.shape[:-1] + (128,))
+    off = _delta_arr(offset)
 
     dq_kernel = functools.partial(
         _dq_kernel, scale=scale, causal=causal, window=window,
@@ -230,6 +249,7 @@ def _bwd(q, k, v, o, lse, do, scale, causal, window, block_q, block_k):
         dq_kernel,
         grid=(B, H, Sq // block_q, Skv // block_k),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, 0)),
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, 0)),
@@ -245,7 +265,7 @@ def _bwd(q, k, v, o, lse, do, scale, causal, window, block_q, block_k):
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(off, q, k, v, do, lse, delta)
 
     dkv_kernel = functools.partial(
         _dkv_kernel, scale=scale, causal=causal, window=window,
@@ -254,6 +274,7 @@ def _bwd(q, k, v, o, lse, do, scale, causal, window, block_q, block_k):
         dkv_kernel,
         grid=(B, H, Skv // block_k, Sq // block_q),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, ki, qi: (b, h, qi, 0)),
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qi: (b, h, ki, 0)),
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qi: (b, h, ki, 0)),
@@ -277,7 +298,7 @@ def _bwd(q, k, v, o, lse, do, scale, causal, window, block_q, block_k):
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(off, q, k, v, do, lse, delta)
     return dq, dk, dv
 
 

@@ -110,11 +110,9 @@ def ring_attention_zigzag(
         return jnp.where(allowed, 0.0, neg)
 
     def in_window(k_stripe, q_stripe):
-        """Stripe-level window reachability: stripe indices are traced
-        ints; kp_max = (k_stripe+1)*c - 1, qp_min = q_stripe*c."""
-        if w is None:
-            return True
-        return (k_stripe + 1) * c - 1 > q_stripe * c - w
+        """Stripe-level window reachability (shared rule with the flash
+        path — one definition, see _zigzag_window_pred)."""
+        return _zigzag_window_pred(w, c, k_stripe, q_stripe)
 
     perm = [(i, (i + 1) % cp) for i in range(cp)]
 
@@ -233,9 +231,12 @@ def ring_attention(
 # [B, Hkv, G, Sq_local, Skv_local] every ring hop. This path replaces each
 # stripe-level einsum with the in-tree Pallas flash kernel
 # (ops/pallas/flash_attention.py), whose VMEM-blocked online softmax never
-# materializes a score buffer. Per stripe pair only two mask cases exist
-# under causality — aligned-diagonal (src == my) or fully visible — so the
-# kernel's static causal flag suffices, selected per hop by lax.cond.
+# materializes a score buffer. ONE kernel covers every stripe pair: the
+# q-vs-k global-position offset rides into the kernel as an SMEM scalar
+# (`delta`), so the causal mask k <= q + delta renders the aligned
+# diagonal (delta 0), fully-past blocks (delta >= stripe) and shifted
+# sliding-window bands alike — plain causal AND Mistral-style windows run
+# on the kernel path.
 #
 # Differentiation: one custom_vjp over the WHOLE ring. The forward saves
 # (q, k, v, out, per-stripe lse); the backward replays the K/V ring and
@@ -266,29 +267,26 @@ def _rep_bhsd(x, groups):
     return jnp.repeat(xt, groups, axis=1) if groups > 1 else xt
 
 
-def _stripe_fwd(q, k, v, diag, scale, block):
-    """(o, lse) for one stripe pair, [B, H, c, D] layout; `diag` (traced)
-    picks the aligned-causal kernel vs the fully-visible one."""
+def _stripe_fwd(q, k, v, delta, window, scale, block):
+    """(o, lse) for one stripe pair, [B, H, c, D] layout. ONE kernel
+    covers every stripe relation: `delta` (traced, an SMEM scalar inside
+    the kernel) is the q-vs-k global-position offset, so the causal mask
+    k <= q + delta renders the aligned diagonal (delta 0), fully-visible
+    past blocks (delta >= c) and shifted sliding-window bands alike."""
     from megatron_tpu.ops.pallas import flash_attention as fa
 
-    o, lse = jax.lax.cond(
-        diag,
-        lambda: fa._fwd(q, k, v, scale, True, None, block, block),
-        lambda: fa._fwd(q, k, v, scale, False, None, block, block))
+    o, lse = fa._fwd(q, k, v, scale, True, window, block, block,
+                     delta=delta)
     return o.astype(jnp.float32), lse[..., 0]
 
 
-def _stripe_bwd(q, k, v, o, lse, do, diag, scale, block):
+def _stripe_bwd(q, k, v, o, lse, do, delta, window, scale, block):
     """(dq, dk, dv) for one stripe pair given the GLOBAL lse."""
     from megatron_tpu.ops.pallas import flash_attention as fa
 
     lse128 = jnp.broadcast_to(lse[..., None], lse.shape + (128,))
-    return jax.lax.cond(
-        diag,
-        lambda: fa._bwd(q, k, v, o, lse128, do, scale, True, None,
-                        block, block),
-        lambda: fa._bwd(q, k, v, o, lse128, do, scale, False, None,
-                        block, block))
+    return fa._bwd(q, k, v, o, lse128, do, scale, True, window,
+                   block, block, offset=delta)
 
 
 def _pick_stripe_block(c: int) -> int:
@@ -300,7 +298,15 @@ def _pick_stripe_block(c: int) -> int:
     return _pick_block(c) or c
 
 
-def _zigzag_flash_fwd_impl(q, k, v, axis_name, block):
+def _zigzag_window_pred(w: Optional[int], c: int, k_stripe, q_stripe):
+    """Stripe-level window reachability (same rule as the einsum path's
+    in_window): stripes entirely before qp_min - w contribute nothing."""
+    if w is None:
+        return True
+    return (k_stripe + 1) * c - 1 > q_stripe * c - w
+
+
+def _zigzag_flash_fwd_impl(q, k, v, axis_name, block, window):
     """Forward ring; q/k/v [B, sq, H, D] local zig-zag layout. Returns
     (out [B, sq, Hq, D], lse_lo, lse_hi [B, Hq, c])."""
     b, sq, hq, d = q.shape
@@ -320,22 +326,32 @@ def _zigzag_flash_fwd_impl(q, k, v, axis_name, block):
         return (jnp.zeros((b, hq, c, d), jnp.float32),
                 jnp.full((b, hq, c), -jnp.inf, jnp.float32))
 
-    def guarded_merge(pred, st, qs, ks, vs, diag):
+    def guarded_merge(pred, st, qs, ks, vs, delta):
         def do(st):
             return _merge_normalized(
-                st, *_stripe_fwd(qs, ks, vs, diag, scale, block))
+                st, *_stripe_fwd(qs, ks, vs, delta, window, scale, block))
 
+        if pred is True:
+            return do(st)
         return jax.lax.cond(pred, do, lambda st: st, st)
 
     def step(carry, r):
         kc, vc, st_lo, st_hi = carry
         src = (my - r) % cp
+        my_hi, src_hi = 2 * cp - 1 - my, 2 * cp - 1 - src
         k_lo, k_hi = _rep_bhsd(kc[:, :c], groups), _rep_bhsd(kc[:, c:], groups)
         v_lo, v_hi = _rep_bhsd(vc[:, :c], groups), _rep_bhsd(vc[:, c:], groups)
-        # stripe reachability/diagonal structure: see ring_attention_zigzag
-        st_lo = guarded_merge(src <= my, st_lo, q_lo, k_lo, v_lo, src == my)
-        st_hi = guarded_merge(True, st_hi, q_hi, k_lo, v_lo, jnp.bool_(False))
-        st_hi = guarded_merge(src >= my, st_hi, q_hi, k_hi, v_hi, src == my)
+        # stripe reachability: see ring_attention_zigzag; per-pair deltas
+        # are the q-vs-k global offsets in zig-zag coordinates
+        st_lo = guarded_merge(
+            (src <= my) & _zigzag_window_pred(window, c, src, my),
+            st_lo, q_lo, k_lo, v_lo, (my - src) * c)
+        st_hi = guarded_merge(
+            _zigzag_window_pred(window, c, src, my_hi),
+            st_hi, q_hi, k_lo, v_lo, (my_hi - src) * c)
+        st_hi = guarded_merge(
+            (src >= my) & _zigzag_window_pred(window, c, src_hi, my_hi),
+            st_hi, q_hi, k_hi, v_hi, (src - my) * c)
         kc = jax.lax.ppermute(kc, axis_name, perm)
         vc = jax.lax.ppermute(vc, axis_name, perm)
         return (kc, vc, st_lo, st_hi), None
@@ -347,22 +363,20 @@ def _zigzag_flash_fwd_impl(q, k, v, axis_name, block):
     return out, lse_lo, lse_hi
 
 
-def _zigzag_flash(q, k, v, *, axis_name, block):
-    out, _, _ = _zigzag_flash_fwd_impl(q, k, v, axis_name, block)
-    return out
-
-
-def _make_zigzag_flash(axis_name: str, block: int):
-    """custom_vjp wrapper (axis_name/block closed over — they are
+def _make_zigzag_flash(axis_name: str, block: int,
+                       window: Optional[int] = None):
+    """custom_vjp wrapper (axis_name/block/window closed over — they are
     configuration, not differentiable inputs)."""
 
     @jax.custom_vjp
     def fn(q, k, v):
-        return _zigzag_flash(q, k, v, axis_name=axis_name, block=block)
+        out, _, _ = _zigzag_flash_fwd_impl(q, k, v, axis_name, block,
+                                           window)
+        return out
 
     def fwd(q, k, v):
         out, lse_lo, lse_hi = _zigzag_flash_fwd_impl(
-            q, k, v, axis_name, block)
+            q, k, v, axis_name, block, window)
         return out, (q, k, v, out, lse_lo, lse_hi)
 
     def bwd(res, do):
@@ -390,32 +404,37 @@ def _make_zigzag_flash(axis_name: str, block: int):
             dx = dx.reshape(b, hkv, groups, c, d).sum(axis=2)
             return jnp.transpose(dx, (0, 2, 1, 3))
 
-        def guarded_bwd(pred, qs, ks, vs, os_, lses, dos, diag):
+        def guarded_bwd(pred, qs, ks, vs, os_, lses, dos, delta):
             def run():
                 return _stripe_bwd(qs, _rep_bhsd(ks, groups),
                                    _rep_bhsd(vs, groups), os_, lses, dos,
-                                   diag, scale, block)
+                                   delta, window, scale, block)
 
             def zero():
                 z_q = jnp.zeros((b, hq, c, d), qs.dtype)
                 z_kv = jnp.zeros((b, hq, c, d), qs.dtype)
                 return z_q, z_kv, z_kv
 
+            if pred is True:
+                return run()
             return jax.lax.cond(pred, run, zero)
 
         def step(carry, r):
             kc, vc, dkc, dvc, dq_lo, dq_hi = carry
             src = (my - r) % cp
+            my_hi, src_hi = 2 * cp - 1 - my, 2 * cp - 1 - src
             k_lo, k_hi = kc[:, :c], kc[:, c:]
             v_lo, v_hi = vc[:, :c], vc[:, c:]
 
-            dq1, dk1, dv1 = guarded_bwd(src <= my, q_lo, k_lo, v_lo,
-                                        o_lo, lse_lo, do_lo, src == my)
-            dq2, dk2, dv2 = guarded_bwd(True, q_hi, k_lo, v_lo,
-                                        o_hi, lse_hi, do_hi,
-                                        jnp.bool_(False))
-            dq3, dk3, dv3 = guarded_bwd(src >= my, q_hi, k_hi, v_hi,
-                                        o_hi, lse_hi, do_hi, src == my)
+            dq1, dk1, dv1 = guarded_bwd(
+                (src <= my) & _zigzag_window_pred(window, c, src, my),
+                q_lo, k_lo, v_lo, o_lo, lse_lo, do_lo, (my - src) * c)
+            dq2, dk2, dv2 = guarded_bwd(
+                _zigzag_window_pred(window, c, src, my_hi),
+                q_hi, k_lo, v_lo, o_hi, lse_hi, do_hi, (my_hi - src) * c)
+            dq3, dk3, dv3 = guarded_bwd(
+                (src >= my) & _zigzag_window_pred(window, c, src_hi, my_hi),
+                q_hi, k_hi, v_hi, o_hi, lse_hi, do_hi, (src - my) * c)
 
             dq_lo = dq_lo + dq1.astype(jnp.float32)
             dq_hi = dq_hi + (dq2 + dq3).astype(jnp.float32)
@@ -484,7 +503,8 @@ def ring_attention_sharded(
     The contiguous path remains for non-causal masks and odd lengths.
 
     inner_impl: None/"auto" = flash stripes on TPU when the shape allows
-    (plain causal, stripe length % 128), einsum elsewhere; "flash"/"einsum"
+    (stripe length % 128; plain causal AND sliding-window — the window
+    band is a kernel mask parameter), einsum elsewhere; "flash"/"einsum"
     force a path (flash forcing is how CPU tests exercise the kernel via
     the pallas interpreter)."""
     use_mesh = mesh
@@ -499,17 +519,12 @@ def ring_attention_sharded(
         if inner_impl is None or inner_impl == "auto":
             from megatron_tpu.ops.pallas.flash_attention import _interpret
 
-            # sliding-window stripes need shifted window masks the static
-            # kernel flags cannot express — the einsum path keeps them
-            use_flash = (sliding_window is None and c % 128 == 0
-                         and not _interpret())
+            use_flash = c % 128 == 0 and not _interpret()
         else:
             use_flash = inner_impl == "flash"
-        if use_flash and sliding_window is not None:
-            raise ValueError("inner_impl='flash' does not support "
-                             "sliding_window; use the einsum path")
         if use_flash:
-            inner = _make_zigzag_flash(AXIS_CONTEXT, _pick_stripe_block(c))
+            inner = _make_zigzag_flash(AXIS_CONTEXT, _pick_stripe_block(c),
+                                       window=sliding_window)
         else:
             inner = lambda q, k, v: ring_attention_zigzag(  # noqa: E731
                 q, k, v, sliding_window=sliding_window)

@@ -132,13 +132,38 @@ def test_ring_flash_inner_gqa_grads():
                                    rtol=5e-4, atol=5e-5)
 
 
-def test_ring_flash_rejects_window():
-    rt = build_mesh(ParallelConfig(context_parallel=2))
+@pytest.mark.parametrize("cp,window", [(2, 8), (4, 6), (2, 3), (4, 40)])
+def test_ring_flash_inner_window_matches_dense(cp, window):
+    """Sliding windows on the kernel path: the stripe delta + static
+    window band must reproduce dense windowed attention exactly."""
+    rt = build_mesh(ParallelConfig(context_parallel=cp))
     q, k, v = _qkv()
-    with pytest.raises(ValueError, match="sliding_window"):
-        with jax.sharding.set_mesh(rt.mesh):
-            ring_attention_sharded(q, k, v, rt.mesh, inner_impl="flash",
-                                   sliding_window=8)
+    want = attention(q, k, v, mask_type="causal", sliding_window=window)
+    with jax.sharding.set_mesh(rt.mesh):
+        got = jax.jit(lambda q, k, v: ring_attention_sharded(
+            q, k, v, rt.mesh, inner_impl="flash",
+            sliding_window=window))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_ring_flash_inner_window_grads_match_dense():
+    rt = build_mesh(ParallelConfig(context_parallel=4))
+    q, k, v = _qkv(b=1, s=32, hq=2, hkv=1, d=8)
+
+    def dense_loss(q, k, v):
+        return jnp.sum(jnp.square(attention(q, k, v, sliding_window=6)))
+
+    def ring_loss(q, k, v):
+        return jnp.sum(jnp.square(ring_attention_sharded(
+            q, k, v, rt.mesh, inner_impl="flash", sliding_window=6)))
+
+    g_dense = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    with jax.sharding.set_mesh(rt.mesh):
+        g_ring = jax.jit(jax.grad(ring_loss, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g_ring, g_dense):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5)
 
 
 def test_cp_decode_fallback_warns():
