@@ -1,15 +1,19 @@
 #!/bin/bash
 # Mixtral-8x7B-class MoE pretraining (beyond the reference: epfLLM has no
-# MoE). Experts shard over the data axis (expert parallelism) and
-# tensor-parallel inside each expert; top-2 renormalized routing with the
-# Switch load-balance loss.
+# MoE). Experts shard over the DEDICATED expert mesh axis
+# (--expert_model_parallel_size, decoupled from dp — the expert count
+# never constrains the data-parallel degree) and tensor-parallel inside
+# each expert; top-2 renormalized routing with the Switch load-balance
+# loss. For single-group runs, --moe_dispatch dropless swaps the GShard
+# capacity einsums for sort-based lax.ragged_dot grouped GEMMs (no token
+# drops, no dense dispatch FLOPs).
 #
-# On a v5p-128 slice: tp16 x dp8 — the 8 experts shard one-per-dp-rank
-# (num_experts must be divisible by the data-parallel degree).
+# On a v5p-128 slice: tp8 x ep8 x dp2 — one expert per ep rank.
 
 python pretrain_gpt.py \
     --model_name mixtral \
-    --tensor_model_parallel_size 16 \
+    --tensor_model_parallel_size 8 \
+    --expert_model_parallel_size 8 \
     --sequence_parallel \
     --use_distributed_optimizer \
     --num_experts 8 \
