@@ -267,25 +267,27 @@ def _rep_bhsd(x, groups):
     return jnp.repeat(xt, groups, axis=1) if groups > 1 else xt
 
 
-def _stripe_fwd(q, k, v, delta, window, scale, block):
+def _stripe_fwd(q, k, v, delta, window, scale, block, causal=True):
     """(o, lse) for one stripe pair, [B, H, c, D] layout. ONE kernel
     covers every stripe relation: `delta` (traced, an SMEM scalar inside
     the kernel) is the q-vs-k global-position offset, so the causal mask
     k <= q + delta renders the aligned diagonal (delta 0), fully-visible
-    past blocks (delta >= c) and shifted sliding-window bands alike."""
+    past blocks (delta >= c) and shifted sliding-window bands alike.
+    causal=False = fully-visible blocks (bidirectional contiguous ring)."""
     from megatron_tpu.ops.pallas import flash_attention as fa
 
-    o, lse = fa._fwd(q, k, v, scale, True, window, block, block,
+    o, lse = fa._fwd(q, k, v, scale, causal, window, block, block,
                      delta=delta)
     return o.astype(jnp.float32), lse[..., 0]
 
 
-def _stripe_bwd(q, k, v, o, lse, do, delta, window, scale, block):
+def _stripe_bwd(q, k, v, o, lse, do, delta, window, scale, block,
+                causal=True):
     """(dq, dk, dv) for one stripe pair given the GLOBAL lse."""
     from megatron_tpu.ops.pallas import flash_attention as fa
 
     lse128 = jnp.broadcast_to(lse[..., None], lse.shape + (128,))
-    return fa._bwd(q, k, v, o, lse128, do, scale, True, window,
+    return fa._bwd(q, k, v, o, lse128, do, scale, causal, window,
                    block, block, offset=delta)
 
 
@@ -467,6 +469,98 @@ def _make_zigzag_flash(axis_name: str, block: int,
     return fn
 
 
+def _contig_flash_fwd_impl(q, k, v, axis_name, block, causal):
+    """Forward contiguous ring (no zig-zag re-striping); q/k/v
+    [B, s_local, H, D]. Serves bidirectional CP (causal=False: every hop
+    fully visible, balance is inherent) — causal contiguous rings keep
+    the zig-zag path, which halves their FLOPs."""
+    b, sq, hq, d = q.shape
+    hkv = k.shape[2]
+    groups = hq // hkv
+    cp = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    scale = float(1.0 / (d ** 0.5))
+    qt = jnp.transpose(q, (0, 2, 1, 3))              # [B, Hq, sq, D]
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    def step(carry, r):
+        kc, vc, st = carry
+        src = (my - r) % cp
+        kb = _rep_bhsd(kc, groups)
+        vb = _rep_bhsd(vc, groups)
+        delta = (my - src) * sq  # only read when causal
+        st = _merge_normalized(
+            st, *_stripe_fwd(qt, kb, vb, delta if causal else 0,
+                             None, scale, block, causal=causal))
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        return (kc, vc, st), None
+
+    st0 = (jnp.zeros((b, hq, sq, d), jnp.float32),
+           jnp.full((b, hq, sq), -jnp.inf, jnp.float32))
+    (_, _, (o, lse)), _ = jax.lax.scan(step, (k, v, st0), jnp.arange(cp))
+    return jnp.transpose(o, (0, 2, 1, 3)).astype(q.dtype), lse
+
+
+def _make_contig_flash(axis_name: str, block: int, causal: bool):
+    """custom_vjp for the contiguous flash ring (same scheme as the
+    zig-zag one: save lse, replay the K/V ring in backward, dk/dv carries
+    rotate home)."""
+
+    @jax.custom_vjp
+    def fn(q, k, v):
+        out, _ = _contig_flash_fwd_impl(q, k, v, axis_name, block, causal)
+        return out
+
+    def fwd(q, k, v):
+        out, lse = _contig_flash_fwd_impl(q, k, v, axis_name, block, causal)
+        return out, (q, k, v, out, lse)
+
+    def bwd(res, do):
+        q, k, v, out, lse = res
+        b, sq, hq, d = q.shape
+        hkv = k.shape[2]
+        groups = hq // hkv
+        cp = jax.lax.axis_size(axis_name)
+        my = jax.lax.axis_index(axis_name)
+        scale = float(1.0 / (d ** 0.5))
+        qt = jnp.transpose(q, (0, 2, 1, 3))
+        ot = jnp.transpose(out, (0, 2, 1, 3))
+        dt = jnp.transpose(do, (0, 2, 1, 3))
+        perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+        def group_sum(dx):
+            dx = dx.reshape(b, hkv, groups, sq, d).sum(axis=2)
+            return jnp.transpose(dx, (0, 2, 1, 3))   # [B, sq, Hkv, D]
+
+        def step(carry, r):
+            kc, vc, dkc, dvc, dq = carry
+            src = (my - r) % cp
+            delta = (my - src) * sq
+            dq_h, dk_h, dv_h = _stripe_bwd(
+                qt, _rep_bhsd(kc, groups), _rep_bhsd(vc, groups), ot, lse,
+                dt, delta if causal else 0, None, scale, block,
+                causal=causal)
+            dq = dq + dq_h.astype(jnp.float32)
+            dkc = dkc + group_sum(dk_h).astype(jnp.float32)
+            dvc = dvc + group_sum(dv_h).astype(jnp.float32)
+            kc = jax.lax.ppermute(kc, axis_name, perm)
+            vc = jax.lax.ppermute(vc, axis_name, perm)
+            dkc = jax.lax.ppermute(dkc, axis_name, perm)
+            dvc = jax.lax.ppermute(dvc, axis_name, perm)
+            return (kc, vc, dkc, dvc, dq), None
+
+        zeros_kv = jnp.zeros((b, sq, hkv, d), jnp.float32)
+        zeros_q = jnp.zeros((b, hq, sq, d), jnp.float32)
+        (_, _, dkc, dvc, dq), _ = jax.lax.scan(
+            step, (k, v, zeros_kv, zeros_kv, zeros_q), jnp.arange(cp))
+        dq = jnp.transpose(dq, (0, 2, 1, 3)).astype(q.dtype)
+        return dq, dkc.astype(k.dtype), dvc.astype(v.dtype)
+
+    fn.defvjp(fwd, bwd)
+    return fn
+
+
 def _zigzag_perm(S: int, cp: int):
     """new-position -> old-global-index so contiguous local blocks become
     (stripe r, stripe 2cp-1-r) per rank r."""
@@ -542,15 +636,34 @@ def ring_attention_sharded(
                  jnp.take(v, perm, axis=1))
         return jnp.take(out, inv, axis=1)
 
-    if inner_impl == "flash":
+    # contiguous ring: bidirectional masks, and causal shapes the zig-zag
+    # permutation can't stripe (S % (2*cp) != 0). The flash inner covers
+    # the no-window cases; sliding windows on the contiguous ring keep
+    # the einsum (zig-zag owns the windowed kernel path for even shapes).
+    contig_flash_ok = cp > 1 and S % cp == 0 and sliding_window is None
+    if inner_impl is None or inner_impl == "auto":
+        from megatron_tpu.ops.pallas.flash_attention import _interpret
+
+        use_flash = (contig_flash_ok and (S // cp) % 128 == 0
+                     and not _interpret())
+    else:
+        use_flash = inner_impl == "flash"
+    if use_flash and not contig_flash_ok:
         # a forced flash request must not silently run einsum
         raise ValueError(
-            "inner_impl='flash' needs the zig-zag branch: causal mask, "
-            f"cp > 1 and S % (2*cp) == 0 (got mask_type={mask_type!r}, "
-            f"cp={cp}, S={S})")
+            "inner_impl='flash' on the contiguous ring needs cp > 1, "
+            f"S % cp == 0 and no sliding window (got "
+            f"mask_type={mask_type!r}, cp={cp}, S={S}, "
+            f"window={sliding_window})")
+    if use_flash:
+        inner = _make_contig_flash(AXIS_CONTEXT,
+                                   _pick_stripe_block(S // cp),
+                                   causal=(mask_type == "causal"))
+    else:
+        inner = lambda q, k, v: ring_attention(  # noqa: E731
+            q, k, v, mask_type=mask_type, sliding_window=sliding_window)
     fn = jax.shard_map(
-        lambda q, k, v: ring_attention(
-            q, k, v, mask_type=mask_type, sliding_window=sliding_window),
+        inner,
         mesh=mesh,
         in_specs=(P(None, AXIS_CONTEXT), P(None, AXIS_CONTEXT), P(None, AXIS_CONTEXT)),
         out_specs=P(None, AXIS_CONTEXT),

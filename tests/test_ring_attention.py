@@ -166,6 +166,43 @@ def test_ring_flash_inner_window_grads_match_dense():
                                    rtol=5e-4, atol=5e-5)
 
 
+@pytest.mark.parametrize("mask_type", ["bidirectional", "causal"])
+def test_contiguous_ring_flash_matches_dense(mask_type):
+    """The contiguous ring's flash inner (bidirectional CP, and causal
+    shapes zig-zag can't stripe) — values AND grads vs dense."""
+    rt = build_mesh(ParallelConfig(context_parallel=4))
+    q, k, v = _qkv(b=1, s=32, hq=4, hkv=2, d=8)
+    want = attention(q, k, v, mask_type=mask_type)
+
+    def make(impl):
+        # mask_type='causal' with S % (2*cp) == 0 would take the zig-zag
+        # branch; drive the contiguous one via a non-zigzag length
+        return lambda q, k, v: ring_attention_sharded(
+            q, k, v, rt.mesh, mask_type=mask_type, inner_impl=impl)
+
+    if mask_type == "causal":
+        # 36 = 4*9: divisible by cp, not by 2*cp — contiguous branch
+        q, k, v = _qkv(b=1, s=36, hq=4, hkv=2, d=8)
+        want = attention(q, k, v, mask_type=mask_type)
+    with jax.sharding.set_mesh(rt.mesh):
+        got = jax.jit(make("flash"))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+    def dense_loss(q, k, v):
+        return jnp.sum(jnp.square(attention(q, k, v, mask_type=mask_type)))
+
+    def ring_loss(q, k, v):
+        return jnp.sum(jnp.square(make("flash")(q, k, v)))
+
+    g_dense = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    with jax.sharding.set_mesh(rt.mesh):
+        g_ring = jax.jit(jax.grad(ring_loss, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g_ring, g_dense):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5)
+
+
 def test_cp_decode_fallback_warns():
     """Decode steps under a CP impl fall back to XLA LOUDLY now."""
     import warnings as w
