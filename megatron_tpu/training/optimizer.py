@@ -52,8 +52,42 @@ class TrainState:
     scaler: Optional[ScalerState]
 
 
-def _wd_mask(path_leaf) -> bool:
-    return path_leaf.ndim >= 2
+# Leaf-name test for "is a bias or a norm scale" in models/params.py's
+# naming scheme: scale / norm_scale / bias / norm_bias / b / bq bk bv bo /
+# b_in b_out / dense_b. Matmul weights (w*, router, dense_w) and
+# embeddings never match.
+_NO_DECAY_RE = None
+
+
+def _wd_mask(name: str, leaf) -> bool:
+    """Whether weight decay applies to a param leaf.
+
+    Matches the reference's param-group split
+    (megatron/optimizer/__init__.py:16-59): biases and ALL norm params are
+    excluded from decay, everything else (matmul weights, embeddings)
+    decays. The reference tests torch's ndim==1; here per-layer norm
+    scales and biases are STACKED (e.g. [num_layers, hidden]), so the
+    test must be by path name, against the naming convention of
+    models/params.py (see _NO_DECAY_RE)."""
+    global _NO_DECAY_RE
+    if _NO_DECAY_RE is None:
+        import re
+
+        _NO_DECAY_RE = re.compile(r"scale|bias|^b([qkvo]|_\w+)?$|_b$")
+    if _NO_DECAY_RE.search(name.rsplit("/", 1)[-1]):
+        return False
+    return leaf.ndim >= 2
+
+
+def _leaf_names(tree: Any):
+    """Slash-joined path names, in jax.tree.leaves order — THE name
+    derivation for both the wd mask and param-group mults (one definition
+    so path-pattern semantics cannot drift apart)."""
+    from jax.tree_util import tree_flatten_with_path
+
+    leaves_with_paths, _ = tree_flatten_with_path(tree)
+    return ["/".join(str(getattr(k, "key", k)) for k in path)
+            for path, _ in leaves_with_paths]
 
 
 def init_train_state(
@@ -131,12 +165,8 @@ def leaf_group_mults(cfg: OptimizerConfig, tree: Any):
     at trace time; first matching pattern wins."""
     import re
 
-    from jax.tree_util import tree_flatten_with_path
-
-    leaves_with_paths, _ = tree_flatten_with_path(tree)
     out = []
-    for path, _ in leaves_with_paths:
-        name = "/".join(str(getattr(k, "key", k)) for k in path)
+    for name in _leaf_names(tree):
         lrm = wdm = 1.0
         for pat, l, w in cfg.param_group_mults:
             if re.search(pat, name):
@@ -176,11 +206,11 @@ def make_optimizer_step(cfg: OptimizerConfig, train_iters: int):
 
         masters = state.master if state.master is not None else state.params
 
-        def adam_leaf(m, v, g, p, lr_mult=1.0, wd_mult=1.0):
+        def adam_leaf(m, v, g, p, decays, lr_mult=1.0, wd_mult=1.0):
             m1 = b1 * m + (1 - b1) * g
             v1 = b2 * v + (1 - b2) * jnp.square(g)
             update = (m1 / bc1) / (jnp.sqrt(v1 / bc2) + cfg.adam_eps)
-            if _wd_mask(p):
+            if decays:
                 update = update + (wd * wd_mult) * p.astype(jnp.float32)
             p1 = p.astype(jnp.float32) - (lr * lr_mult) * update
             return m1, v1, p1
@@ -191,11 +221,12 @@ def make_optimizer_step(cfg: OptimizerConfig, train_iters: int):
         nus = jax.tree.leaves(state.nu)
         gs = jax.tree.leaves(grads)
         ps = jax.tree.leaves(masters)
+        names = _leaf_names(masters)
         mults = (leaf_group_mults(cfg, masters) if cfg.param_group_mults
                  else [(1.0, 1.0)] * len(ps))
-        out = [adam_leaf(m, v, g, p, lm, wm)
-               for (m, v, g, p), (lm, wm) in zip(zip(mus, nus, gs, ps),
-                                                 mults)]
+        out = [adam_leaf(m, v, g, p, _wd_mask(name, p), lm, wm)
+               for (m, v, g, p), name, (lm, wm) in zip(
+                   zip(mus, nus, gs, ps), names, mults)]
         new_mu = jax.tree.unflatten(flat, [o[0] for o in out])
         new_nu = jax.tree.unflatten(flat, [o[1] for o in out])
         new_master = jax.tree.unflatten(flat, [o[2] for o in out])

@@ -124,6 +124,30 @@ def test_weight_decay_only_on_matrices():
     np.testing.assert_allclose(np.asarray(new_state.params["b"]), 1.0)
 
 
+def test_weight_decay_mask_is_path_aware():
+    """Stacked per-layer norm scales ([L, h], 2-D) and stacked biases
+    (bq/b_in..., 2-D) must NOT decay — the reference's apex param-group
+    split excludes biases and all norm params, and leaf ndim cannot tell
+    here because stacking adds a leading dim (VERDICT-r5-era fix; the old
+    ndim>=2 mask silently decayed them)."""
+    from megatron_tpu.training.optimizer import _wd_mask
+
+    leaf2d = jnp.ones((2, 4))
+    no_decay = ["layers/ln1/scale", "layers/ln2/scale", "final_ln/bias",
+                "layers/attn/bq", "layers/attn/bo", "layers/mlp/b_in",
+                "layers/moe/b_out", "mlm_head/norm_scale",
+                "mlm_head/dense_b", "pooler/b", "mlm_head/bias"]
+    decay = ["layers/attn/wq", "layers/mlp/w_in", "embed/tokens",
+             "lm_head/w", "layers/moe/router", "mlm_head/dense_w",
+             "pooler/w", "embed/pos"]
+    for n in no_decay:
+        assert not _wd_mask(n, leaf2d), n
+    for n in decay:
+        assert _wd_mask(n, leaf2d), n
+    # 1-D leaves never decay regardless of name
+    assert not _wd_mask("lm_head/w", jnp.ones((4,)))
+
+
 def test_train_step_microbatch_equivalence():
     """1 microbatch of 8 == 4 microbatches of 2 (same grads).
 
