@@ -247,8 +247,17 @@ def ring_attention(
 
 
 def _merge_normalized(st, o_i, lse_i):
-    """Merge a block's (normalized out, lse) into the running pair."""
+    """Merge a block's (normalized out, lse) into the running pair.
+
+    The kernel reports fully-masked rows with a finite ~-1e30 lse sentinel
+    (flash_attention._NEG_INF); clamp anything at sentinel depth to -inf so
+    such rows carry ZERO merge weight no matter which hop merges first —
+    correctness must not depend on the diagonal/past hop preceding
+    fully-masked ones (ADVICE r4)."""
+    from megatron_tpu.ops.pallas.flash_attention import _NEG_INF
+
     out, lse = st
+    lse_i = jnp.where(lse_i <= _NEG_INF / 2, -jnp.inf, lse_i)
     m = jnp.maximum(lse, lse_i)
     m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
     w_old = jnp.where(jnp.isfinite(lse), jnp.exp(lse - m_safe), 0.0)
@@ -489,9 +498,23 @@ def _contig_flash_fwd_impl(q, k, v, axis_name, block, causal):
         kb = _rep_bhsd(kc, groups)
         vb = _rep_bhsd(vc, groups)
         delta = (my - src) * sq  # only read when causal
-        st = _merge_normalized(
-            st, *_stripe_fwd(qt, kb, vb, delta if causal else 0,
-                             None, scale, block, causal=causal))
+
+        def run():
+            return _stripe_fwd(qt, kb, vb, delta if causal else 0,
+                               None, scale, block, causal=causal)
+
+        if causal:
+            # entirely-future blocks (src > my) are fully masked — skip
+            # the kernel instead of burning a stripe of FLOPs (ADVICE r4);
+            # merging (0, -inf) is a no-op under the sentinel clamp
+            def zero():
+                return (jnp.zeros((b, hq, sq, d), jnp.float32),
+                        jnp.full((b, hq, sq), -jnp.inf, jnp.float32))
+
+            o_i, lse_i = jax.lax.cond(src <= my, run, zero)
+        else:
+            o_i, lse_i = run()
+        st = _merge_normalized(st, o_i, lse_i)
         kc = jax.lax.ppermute(kc, axis_name, perm)
         vc = jax.lax.ppermute(vc, axis_name, perm)
         return (kc, vc, st), None
@@ -537,10 +560,21 @@ def _make_contig_flash(axis_name: str, block: int, causal: bool):
             kc, vc, dkc, dvc, dq = carry
             src = (my - r) % cp
             delta = (my - src) * sq
-            dq_h, dk_h, dv_h = _stripe_bwd(
-                qt, _rep_bhsd(kc, groups), _rep_bhsd(vc, groups), ot, lse,
-                dt, delta if causal else 0, None, scale, block,
-                causal=causal)
+
+            def run():
+                return _stripe_bwd(
+                    qt, _rep_bhsd(kc, groups), _rep_bhsd(vc, groups), ot,
+                    lse, dt, delta if causal else 0, None, scale, block,
+                    causal=causal)
+
+            if causal:
+                def zero():
+                    z = jnp.zeros((b, hq, sq, d), qt.dtype)
+                    return z, z, z
+
+                dq_h, dk_h, dv_h = jax.lax.cond(src <= my, run, zero)
+            else:
+                dq_h, dk_h, dv_h = run()
             dq = dq + dq_h.astype(jnp.float32)
             dkc = dkc + group_sum(dk_h).astype(jnp.float32)
             dvc = dvc + group_sum(dv_h).astype(jnp.float32)
@@ -610,12 +644,19 @@ def ring_attention_sharded(
     S = q.shape[1]
     if mask_type == "causal" and cp > 1 and S % (2 * cp) == 0:
         c = S // (2 * cp)
-        if inner_impl is None or inner_impl == "auto":
-            from megatron_tpu.ops.pallas.flash_attention import _interpret
+        from megatron_tpu.ops.pallas.flash_attention import _interpret
 
+        if inner_impl is None or inner_impl == "auto":
             use_flash = c % 128 == 0 and not _interpret()
         else:
             use_flash = inner_impl == "flash"
+        if use_flash and c % 128 != 0 and not _interpret():
+            # a forced flash request must fail loudly, not with an opaque
+            # Mosaic tiling error from a block == stripe fallback
+            raise ValueError(
+                "inner_impl='flash' on the zig-zag ring needs stripe "
+                "length S // (2*cp) to be a multiple of 128 on TPU (got "
+                f"S={S}, cp={cp}, stripe={c})")
         if use_flash:
             inner = _make_zigzag_flash(AXIS_CONTEXT, _pick_stripe_block(c),
                                        window=sliding_window)

@@ -109,8 +109,16 @@ def _ambient_mesh():
         legacy = mesh_lib.thread_resources.env.physical_mesh
         if legacy is not None and not legacy.empty:
             return legacy
-    except Exception:  # noqa: BLE001 - private API; any change => fallback
-        pass
+    except Exception as e:  # noqa: BLE001 - private API; any change => fallback
+        # Fail soft but NOT silent: a jax upgrade breaking this probe would
+        # otherwise quietly pin large template restores to one device and
+        # reintroduce the OOM this path exists to avoid (ADVICE r4).
+        import warnings
+
+        warnings.warn(
+            "checkpointing: ambient-mesh probe via jax._src.mesh failed "
+            f"({type(e).__name__}: {e}); template restores without "
+            "shardings fall back to single-device placement")
     return None
 
 
