@@ -74,7 +74,13 @@ def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
                    default=None,
                    help="capacity: GShard einsum dispatch (EP-shardable); "
                         "dropless: sort + lax.ragged_dot grouped GEMMs, "
-                        "no token drops (ep=1 only)")
+                        "no token drops (under ep>1: explicit expert-axis "
+                        "all-to-all dispatch)")
+    g.add_argument("--moe_ep_buffer_factor", type=float, default=None,
+                   help="dropless-EP receive buffer = n*top_k*factor rows "
+                        "per expert shard (default: ep, exact dropless; "
+                        "smaller scales FLOPs/memory at the cost of "
+                        "greedy drops under routing imbalance)")
     g.add_argument("--moe_renorm_gates", action="store_true", default=None)
     g.add_argument("--no_moe_renorm_gates", action="store_false",
                    dest="moe_renorm_gates",
@@ -303,7 +309,8 @@ def _moe_overrides(args) -> dict:
     out = {}
     for name in ("num_experts", "moe_top_k", "moe_capacity_factor",
                  "moe_aux_loss_coeff", "moe_z_loss_coeff",
-                 "moe_renorm_gates", "moe_group_size", "moe_dispatch"):
+                 "moe_renorm_gates", "moe_group_size", "moe_dispatch",
+                 "moe_ep_buffer_factor"):
         v = getattr(args, name, None)
         if v is not None:
             out[name] = v

@@ -124,9 +124,16 @@ class ModelConfig:
     moe_renorm_gates: bool = True
     # "capacity": GShard grouped capacity dispatch (einsum, EP-shardable);
     # "dropless": sort-based dispatch over lax.ragged_dot — NO token ever
-    # dropped and no dense [.., E, C] dispatch FLOPs; single expert group
-    # only (ep == 1)
+    # dropped and no dense [.., E, C] dispatch FLOPs; under ep > 1 rows
+    # travel an explicit expert-axis all-to-all (moe_block_dropless_ep)
     moe_dispatch: str = "capacity"
+    # Receive-buffer factor for dropless dispatch under expert
+    # parallelism: each expert shard accepts up to n_local*top_k*factor
+    # rows per step. None = ep (mathematically dropless for any routing,
+    # the default); smaller trades FLOPs/memory (both scale with the
+    # buffer) for greedy source-order drops when routing is imbalanced
+    # beyond factor x fair share.
+    moe_ep_buffer_factor: Optional[float] = None
     # GShard token-group size for dispatch: capacity is enforced within
     # fixed-size groups of tokens so the combine/dispatch tensors are
     # [G, Sg, E, Cg] — linear in total tokens — instead of the global

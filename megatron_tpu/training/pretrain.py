@@ -141,13 +141,6 @@ class TrainLoop:
             raise ValueError(
                 f"expert_parallel={self.rt.ep} set but the model has no "
                 "experts — use data_parallel instead")
-        if (E is not None and model_cfg.moe_dispatch == "dropless"
-                and self.rt.ep > 1):
-            raise ValueError(
-                "moe_dispatch='dropless' is single-expert-group only "
-                "(token counts per expert are runtime values GSPMD cannot "
-                "shard statically) — use capacity dispatch with "
-                f"expert_parallel={self.rt.ep}, or ep=1")
         self.specs = (param_specs_fn or param_specs)(model_cfg)
         params = (init_params_fn or init_params)(model_cfg, jax.random.fold_in(
             jax.random.PRNGKey(run_cfg.training.seed), 0))

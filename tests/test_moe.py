@@ -297,7 +297,107 @@ def test_moe_dropless_exact_under_data_sharding():
                                    rtol=1e-4, atol=1e-6)
 
 
-def test_moe_dropless_requires_single_expert_group():
+def _ep_mesh(**kw):
+    from megatron_tpu.parallel.mesh import build_mesh
+
+    return build_mesh(ParallelConfig(**kw))
+
+
+def test_moe_dropless_ep_matches_single_group():
+    """Dropless under expert parallelism (VERDICT r4 #3): the explicit
+    expert-axis all-to-all path on ep2 x tp2 reproduces the ep=1
+    sort/ragged_dot path exactly — values, aux loss, AND grads."""
+    from megatron_tpu.ops.moe import moe_block, moe_block_dropless
+
+    cfg = _moe_cfg(moe_dispatch="dropless")
+    p = init_params(cfg, jax.random.PRNGKey(5))
+    lp = jax.tree.map(lambda a: a[0], p["layers"])
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((4, 16, 32)).astype(np.float32))
+
+    y_ref, aux_ref = moe_block_dropless(cfg, lp["moe"], x)
+    rt = _ep_mesh(expert_parallel=2, tensor_parallel=2)
+    with jax.sharding.set_mesh(rt.mesh):
+        y_ep, aux_ep = jax.jit(
+            lambda lp, x: moe_block(cfg, lp["moe"], x))(lp, x)
+    np.testing.assert_allclose(np.asarray(y_ep), np.asarray(y_ref),
+                               rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(float(aux_ep), float(aux_ref), rtol=1e-5)
+
+    def loss(fn):
+        def f(lp, x):
+            y, aux = fn(cfg, lp["moe"], x)
+            return jnp.sum(jnp.square(y)) + aux
+        return f
+
+    g_ref = jax.grad(loss(moe_block_dropless))(lp, x)
+    with jax.sharding.set_mesh(rt.mesh):
+        g_ep = jax.jit(jax.grad(loss(moe_block)))(lp, x)
+    for a, b in zip(jax.tree.leaves(g_ep), jax.tree.leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-6)
+
+
+def test_moe_dropless_ep_exact_under_extreme_imbalance():
+    """Default receive buffer (factor = ep) is mathematically dropless:
+    even with the router saturated toward ONE expert (everything lands on
+    one shard), ep2 matches the ep=1 dropless path exactly."""
+    from megatron_tpu.ops.moe import moe_block, moe_block_dropless
+
+    cfg = _moe_cfg(moe_dispatch="dropless", moe_top_k=1,
+                   moe_renorm_gates=False)
+    p = init_params(cfg, jax.random.PRNGKey(5))
+    lp = jax.tree.map(lambda a: a[0], p["layers"])
+    router = np.zeros((32, 4), np.float32)
+    router[:, 0] = 10.0  # every token picks expert 0 (shard 0)
+    lp["moe"]["router"] = jnp.asarray(router)
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.standard_normal((2, 16, 32)).astype(np.float32))
+
+    y_ref, _ = moe_block_dropless(cfg, lp["moe"], x)
+    rt = _ep_mesh(expert_parallel=2)
+    with jax.sharding.set_mesh(rt.mesh):
+        y_ep, _ = jax.jit(lambda lp, x: moe_block(cfg, lp["moe"], x))(lp, x)
+    np.testing.assert_allclose(np.asarray(y_ep), np.asarray(y_ref),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_moe_dropless_ep_buffer_factor_semantics():
+    """moe_ep_buffer_factor < ep bounds each shard's receive buffer:
+    balanced routing still fits exactly; saturated routing overflows the
+    one hot shard and the overflow rows (greedy source-order clamp) lose
+    that expert — their tokens pass through with zero MLP output under
+    top_k=1, while kept tokens still match the reference."""
+    from megatron_tpu.ops.moe import moe_block, moe_block_dropless
+
+    cfg = _moe_cfg(moe_dispatch="dropless", moe_top_k=1,
+                   moe_renorm_gates=False, moe_ep_buffer_factor=1.0)
+    p = init_params(cfg, jax.random.PRNGKey(5))
+    lp = jax.tree.map(lambda a: a[0], p["layers"])
+    rng = np.random.default_rng(13)
+    x = jnp.asarray(rng.standard_normal((2, 16, 32)).astype(np.float32))
+    rt = _ep_mesh(expert_parallel=2)
+
+    # saturated routing at factor=1.0: the hot shard keeps its buffer's
+    # worth of rows (greedy in source order), the rest zero out
+    router = np.zeros((32, 4), np.float32)
+    router[:, 0] = 10.0
+    lp["moe"]["router"] = jnp.asarray(router)
+    y_ref2, _ = moe_block_dropless(cfg, lp["moe"], x)
+    with jax.sharding.set_mesh(rt.mesh):
+        y_ep2, _ = jax.jit(lambda lp, x: moe_block(cfg, lp["moe"], x))(lp, x)
+    y_ref2, y_ep2 = np.asarray(y_ref2), np.asarray(y_ep2)
+    zero_rows = np.all(np.isclose(y_ep2.reshape(-1, 32), 0.0, atol=1e-7), -1)
+    assert zero_rows.sum() > 0, "saturation must overflow the buffer"
+    kept = ~zero_rows
+    np.testing.assert_allclose(y_ep2.reshape(-1, 32)[kept],
+                               y_ref2.reshape(-1, 32)[kept],
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_moe_dropless_trains_with_expert_axis():
+    """The r4 refusal is gone: dropless + ep2 runs a full TrainLoop step
+    (the ep path inside the fused train step, ZeRO-1 on)."""
     from megatron_tpu.training.pretrain import TrainLoop
     from megatron_tpu.config import (
         OptimizerConfig, RunConfig, TrainingConfig,
@@ -305,12 +405,24 @@ def test_moe_dropless_requires_single_expert_group():
 
     cfg = RunConfig(
         model=_moe_cfg(num_experts=4, moe_dispatch="dropless"),
-        parallel=ParallelConfig(expert_parallel=2),
-        optimizer=OptimizerConfig(lr=1e-3),
+        parallel=ParallelConfig(expert_parallel=2, tensor_parallel=2),
+        optimizer=OptimizerConfig(lr=1e-3, use_distributed_optimizer=True),
         training=TrainingConfig(micro_batch_size=1, global_batch_size=4,
-                                train_iters=1))
-    with pytest.raises(ValueError, match="dropless"):
-        TrainLoop(cfg, log=lambda s: None)
+                                train_iters=2, log_interval=1))
+    logs = []
+    loop = TrainLoop(cfg, log=logs.append)
+    rng = np.random.default_rng(0)
+    S = cfg.model.seq_length
+
+    def factory(consumed, gbs):
+        while True:
+            yield {"tokens": rng.integers(0, 64, (gbs, S)).astype(np.int64),
+                   "labels": rng.integers(0, 64, (gbs, S)).astype(np.int64),
+                   "loss_mask": np.ones((gbs, S), np.float32)}
+
+    state = loop.train(factory)
+    assert int(state.step) == 2
+    assert any("lm loss" in l for l in logs)
 
 
 def test_moe_experts_must_divide_ep_not_dp():
