@@ -198,6 +198,17 @@ def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
     g.add_argument("--min_loss_scale", type=float, default=1.0)
     g.add_argument("--loss_scale_window", type=int, default=1000)
     g.add_argument("--hysteresis", type=int, default=2)
+    g.add_argument("--fp8_e4m3", action="store_true",
+                   help="fp8 training GEMMs, everything e4m3 "
+                        "(ref TransformerEngine Format.E4M3)")
+    g.add_argument("--fp8_hybrid", action="store_true",
+                   help="fp8 training GEMMs, e4m3 forward / e5m2 grads "
+                        "(ref TransformerEngine Format.HYBRID)")
+    g.add_argument("--fp8_margin", type=int, default=0,
+                   help="back quantization scales off by 2^-margin")
+    g.add_argument("--no_fp8_wgrad", action="store_false", dest="fp8_wgrad",
+                   default=True,
+                   help="run the wgrad GEMM in higher precision")
 
     g = p.add_argument_group("distributed")
     g.add_argument("--tensor_model_parallel_size", type=int, default=1)
@@ -303,6 +314,21 @@ def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
     return p
 
 
+def _fp8_overrides(args) -> dict:
+    """ref --fp8_e4m3/--fp8_hybrid are mutually exclusive store_true flags
+    (megatron/arguments.py:313)."""
+    if getattr(args, "fp8_e4m3", False) and getattr(args, "fp8_hybrid", False):
+        raise ValueError("cannot train with both fp8 e4m3 and hybrid "
+                         "formatting (pick --fp8_e4m3 or --fp8_hybrid)")
+    out = {"fp8_margin": getattr(args, "fp8_margin", 0),
+           "fp8_wgrad": getattr(args, "fp8_wgrad", True)}
+    if getattr(args, "fp8_e4m3", False):
+        out["fp8_format"] = "e4m3"
+    elif getattr(args, "fp8_hybrid", False):
+        out["fp8_format"] = "hybrid"
+    return out
+
+
 def _moe_overrides(args) -> dict:
     """MoE knobs that were explicitly passed (None = flag absent, keep the
     preset's or ModelConfig's value)."""
@@ -388,6 +414,7 @@ def args_to_run_config(args) -> RunConfig:
         overrides["attention_impl"] = args.attention_impl
         overrides["ce_chunk_size"] = args.ce_chunk_size
         overrides["params_dtype"] = _dtype_name(args)
+        overrides.update(_fp8_overrides(args))
         if args.tie_embed_logits is not None:  # explicit (no_)tie flag
             overrides["tie_embed_logits"] = args.tie_embed_logits
         overrides.update(_moe_overrides(args))
@@ -435,6 +462,7 @@ def args_to_run_config(args) -> RunConfig:
             params_dtype=_dtype_name(args),
             attention_impl=args.attention_impl,
             ce_chunk_size=args.ce_chunk_size,
+            **_fp8_overrides(args),
         ).validate()
 
     vpp = None

@@ -154,6 +154,13 @@ class ModelConfig:
 
     # numerics
     params_dtype: str = "bfloat16"
+    # fp8 training GEMMs (ref: TransformerEngine autocast,
+    # megatron/model/transformer.py:962-1043): None | "e4m3" | "hybrid"
+    # (e4m3 forward, e5m2 grads). Current-scaling TPU substitution for
+    # the DelayedScaling recipe — see ops/fp8.py for the design argument.
+    fp8_format: Optional[str] = None
+    fp8_margin: int = 0          # ref --fp8_margin: scale back-off 2^-m
+    fp8_wgrad: bool = True       # ref --no_fp8_wgrad: fp32 wgrad GEMM
     # compute softmax / norms in fp32 (ref: attention_softmax_in_fp32)
     softmax_fp32: bool = True
     attn_mask_type: str = "causal"
@@ -215,6 +222,10 @@ class ModelConfig:
             raise ValueError(f"bad attn_mask_type {self.attn_mask_type}")
         if self.attention_impl not in ATTENTION_IMPLS:
             raise ValueError(f"bad attention_impl {self.attention_impl}")
+        if self.fp8_format not in (None, "e4m3", "hybrid"):
+            raise ValueError(
+                f"fp8_format={self.fp8_format!r} must be None, 'e4m3' or "
+                "'hybrid' (ref --fp8_e4m3 / --fp8_hybrid)")
         if self.use_post_ln and self.parallel_attn:
             raise ValueError("use_post_ln is incompatible with parallel_attn")
         if self.hidden_size % self.num_attention_heads and self.kv_channels is None:

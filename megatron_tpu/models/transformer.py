@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from megatron_tpu.config import ModelConfig
 from megatron_tpu.ops.activations import apply_activation
 from megatron_tpu.ops.attention import attention
+from megatron_tpu.ops.fp8 import maybe_fp8_matmul
 from megatron_tpu.ops.moe import moe_block
 from megatron_tpu.ops.normalization import norm_forward
 from megatron_tpu.ops.rotary import apply_rotary_emb
@@ -69,9 +70,9 @@ def attention_block(
     D = cfg.head_dim
     nq, nkv = cfg.num_attention_heads, cfg.n_kv_heads
 
-    q = jnp.einsum("bsh,hd->bsd", x, deq(p["wq"], x.dtype))
-    k = jnp.einsum("bsh,hd->bsd", x, deq(p["wk"], x.dtype))
-    v = jnp.einsum("bsh,hd->bsd", x, deq(p["wv"], x.dtype))
+    q = maybe_fp8_matmul(cfg, x, deq(p["wq"], x.dtype))
+    k = maybe_fp8_matmul(cfg, x, deq(p["wk"], x.dtype))
+    v = maybe_fp8_matmul(cfg, x, deq(p["wv"], x.dtype))
     if "bq" in p:
         q, k, v = q + p["bq"], k + p["bk"], v + p["bv"]
     q = q.reshape(b, s, nq, D)
@@ -143,19 +144,19 @@ def attention_block(
         impl=cfg.attention_impl,
         softmax_fp32=cfg.softmax_fp32,
     )
-    out = jnp.einsum("bsd,dh->bsh", ctx.reshape(b, s, nq * D),
-                     deq(p["wo"], ctx.dtype))
+    out = maybe_fp8_matmul(cfg, ctx.reshape(b, s, nq * D),
+                           deq(p["wo"], ctx.dtype))
     if "bo" in p:
         out = out + p["bo"]
     return out, kv_cache
 
 
 def mlp_block(cfg: ModelConfig, p: Dict[str, Any], x: jnp.ndarray) -> jnp.ndarray:
-    h = jnp.einsum("bsh,hf->bsf", x, deq(p["w_in"], x.dtype))
+    h = maybe_fp8_matmul(cfg, x, deq(p["w_in"], x.dtype))
     if "b_in" in p:
         h = h + p["b_in"]
     h = apply_activation(cfg.activation, h)
-    out = jnp.einsum("bsf,fh->bsh", h, deq(p["w_out"], h.dtype))
+    out = maybe_fp8_matmul(cfg, h, deq(p["w_out"], h.dtype))
     if "b_out" in p:
         out = out + p["b_out"]
     return out
