@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""Standalone stressor for the full-suite XLA:CPU SIGABRT (VERDICT r4 #5).
+
+History: once the test suite grew past ~350 tests, the pytest process
+intermittently died with a raw SIGABRT (no CHECK/assert text) inside a
+compiled XLA:CPU execution — always in the topology-matrix module (the
+point of peak accumulated native state), never when that module ran
+standalone, and immune to jax.clear_caches(). The suite works around it by
+running the matrix in a subprocess (tests/test_parallel_matrix.py).
+
+This tool replays the suspected mechanism in isolation so the failure is
+either reproduced standalone or bounded as resource exhaustion: a child
+process compiles and executes a stream of DISTINCT sharded train-step-like
+programs on the 8-device fake mesh (distinct shapes AND a distinct inlined
+constant each -> a fresh executable every iteration, like a long pytest
+run), sampling native-resource telemetry every few programs:
+
+  * RSS                 (a pytest run RETAINS its jitted functions —
+                         modules and fixtures stay imported — so compiled
+                         code and buffers accumulate for the whole run;
+                         MEGATRON_TPU_REPRO_RETAIN=1, the default,
+                         reproduces that. Measured here: with retention
+                         RSS grows without bound; with RETAIN=0 the
+                         executables are GC'd and RSS plateaus ~440 MB —
+                         which already rules out a plain leak and points
+                         at retained-state accumulation)
+  * VMA count           (/proc/self/maps lines; each executable maps
+                         code pages + guard pages — vm.max_map_count is a
+                         hard wall at which mmap fails and XLA aborts)
+  * thread count        (thread-pool leakage would hit RLIMIT_NPROC /
+                         pthread_create failure -> abort() without a
+                         CHECK message, matching the observed signature)
+
+Driver mode (default) runs the child via subprocess, prints the telemetry
+trail, and classifies the outcome:
+
+    python tools/repro_sigabrt.py             # ~5 min, N=240 programs
+    MEGATRON_TPU_REPRO_N=1000 python tools/repro_sigabrt.py   # heavier
+
+Exit report: "reproduced: signal -6 after K programs" with the telemetry
+tail, or "not reproduced after N programs" + growth rates per 100
+programs, which is the evidence for (or against) the exhaustion theory.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+N = int(os.environ.get("MEGATRON_TPU_REPRO_N", "240"))
+# retain every jitted function for the life of the process, like a pytest
+# run whose modules/fixtures keep compiled functions referenced until exit
+RETAIN = os.environ.get("MEGATRON_TPU_REPRO_RETAIN", "1") == "1"
+
+
+def child():
+    sys.path.insert(0, REPO)
+    from megatron_tpu.platform import force_cpu
+
+    force_cpu(8)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from megatron_tpu.config import ParallelConfig
+    from megatron_tpu.parallel.mesh import build_mesh
+
+    rt = build_mesh(ParallelConfig(tensor_parallel=2, pipeline_parallel=2,
+                                   context_parallel=2,
+                                   sequence_parallel=True))
+
+    def telemetry():
+        rss = vmas = 0
+        with open("/proc/self/status") as f:
+            for ln in f:
+                if ln.startswith("VmRSS"):
+                    rss = int(ln.split()[1]) // 1024
+                elif ln.startswith("Threads"):
+                    threads = int(ln.split()[1])
+        with open("/proc/self/maps") as f:
+            vmas = sum(1 for _ in f)
+        return {"rss_mb": rss, "vmas": vmas, "threads": threads}
+
+    rng = np.random.default_rng(0)
+    keep = []
+    for i in range(N):
+        # distinct shapes AND a distinct inlined constant per iteration =>
+        # every program is a fresh executable (pure shape cycling would
+        # start hitting jax's compilation cache after one lap)
+        h = 16 + 8 * (i % 13)
+        s = 8 * (2 + (i % 5))
+        lr = 0.01 * (1.0 + i / 1000.0)
+
+        def step(w, x):
+            y = jnp.tanh(x @ w)
+            loss = jnp.sum(y * y)
+            g = jax.grad(lambda w: jnp.sum(jnp.tanh(x @ w) ** 2))(w)
+            return loss, w - lr * g
+
+        w = jax.device_put(
+            jnp.asarray(rng.standard_normal((h, h)), jnp.float32),
+            NamedSharding(rt.mesh, P("tensor", None)))
+        x = jax.device_put(
+            jnp.asarray(rng.standard_normal((8, s, h)), jnp.float32),
+            NamedSharding(rt.mesh, P("data", "context", None)))
+        f = jax.jit(step)
+        with jax.sharding.set_mesh(rt.mesh):
+            loss, w2 = f(w, x)
+            float(loss)
+        if RETAIN:
+            keep.append(f)
+        if i % 20 == 0 or i == N - 1:
+            rec = {"i": i, **telemetry()}
+            print(json.dumps(rec), flush=True)
+    print(json.dumps({"done": N}), flush=True)
+
+
+def main():
+    if "--child" in sys.argv:
+        child()
+        return
+    env = dict(os.environ)
+    env["MEGATRON_TPU_REPRO_CHILD"] = "1"
+    try:
+        r = subprocess.run([sys.executable, os.path.abspath(__file__),
+                            "--child"],
+                           capture_output=True, text=True, timeout=7200,
+                           env=env)
+        stdout, stderr, rc = r.stdout, r.stderr, r.returncode
+    except subprocess.TimeoutExpired as e:
+        # a WEDGE is itself a result — keep the telemetry trail
+        stdout = (e.stdout or b"").decode() if isinstance(e.stdout, bytes) \
+            else (e.stdout or "")
+        stderr = (e.stderr or b"").decode() if isinstance(e.stderr, bytes) \
+            else (e.stderr or "")
+        rc = "timeout"
+    lines = [ln for ln in stdout.splitlines() if ln.startswith("{")]
+    recs = [json.loads(ln) for ln in lines]
+    tel = [t for t in recs if "i" in t]
+    for t in tel[-5:]:
+        print(t)
+    if rc != 0:
+        kind = ("WEDGED past 7200s" if rc == "timeout"
+                else f"died rc={rc}" + (f" (signal {-rc})" if isinstance(rc, int) and rc < 0 else ""))
+        print(f"REPRODUCED-CLASS OUTCOME: child {kind} after "
+              f"{tel[-1]['i'] if tel else '?'} programs")
+        print("stderr tail:", stderr[-1500:])
+        sys.exit(1)
+    done = any("done" in t for t in recs)
+    if not done:
+        print(f"INCONCLUSIVE: child exited 0 without finishing "
+              f"({len(tel)} telemetry records); stderr tail: {stderr[-500:]}")
+        sys.exit(2)
+    if len(tel) >= 2:
+        a, b = tel[0], tel[-1]
+        span = max(1, b["i"] - a["i"])
+        print(f"not reproduced after {N} programs. Growth per 100 programs: "
+              f"RSS {100 * (b['rss_mb'] - a['rss_mb']) / span:.0f} MB, "
+              f"VMAs {100 * (b['vmas'] - a['vmas']) / span:.0f}, "
+              f"threads {100 * (b['threads'] - a['threads']) / span:.1f}")
+        mode = "retained" if RETAIN else "dropped"
+        print(f"(jitted functions {mode} — see MEGATRON_TPU_REPRO_RETAIN)")
+
+
+if __name__ == "__main__":
+    main()
