@@ -16,7 +16,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from megatron_tpu.platform import force_cpu  # noqa: E402
 
-force_cpu(8)
+# MEGATRON_TPU_TEST_PLATFORM=tpu lets a tunnel-window capture run the
+# single-chip-safe kernel tests on the REAL backend (tools/tpu_capture.py);
+# default is the 8-device fake CPU mesh.
+if os.environ.get("MEGATRON_TPU_TEST_PLATFORM", "cpu") == "cpu":
+    force_cpu(8)
 
 
 def pytest_configure(config):

@@ -96,6 +96,16 @@ def main():
                 with open(os.path.join(EVIDENCE, "BENCH_success.json"),
                           "w") as f:
                     json.dump(rec, f, indent=1)
+                # the tunnel is open RIGHT NOW — harvest the rest of the
+                # on-device list while it lasts (items are budgeted and
+                # the headline number above is already safe on disk)
+                try:
+                    subprocess.run(
+                        [sys.executable,
+                         os.path.join(REPO, "tools", "tpu_capture.py")],
+                        timeout=3600, cwd=REPO)
+                except Exception as e:  # noqa: BLE001 - capture is best-effort
+                    print(f"tpu_capture after success failed: {e}")
                 return
             time.sleep(max(0.0, min(RETRY_EVERY_S, t_end - time.time())))
     finally:
