@@ -280,10 +280,16 @@ def _host_random_params(cfg, seed=0, std=0.02):
     return jax.tree.map(mk, shapes)
 
 
+_SERVING_HOST_CACHE = {}
+
+
 def serving_int8_7b_bench(deadline, cfg=None, B=4, prompt_len=64,
-                          new_tokens=128):
-    """Llama-2-7B geometry, int8 weights, decode tokens/s (random weights —
-    throughput is weight-value-independent). Ref north star: BASELINE.md."""
+                          new_tokens=128, mode="int8"):
+    """Llama-2-7B geometry, int8 or fp8(e4m3) weights, decode tokens/s
+    (random weights — throughput is weight-value-independent). Ref north
+    star: BASELINE.md; the fp8 point answers VERDICT r4 #7's fp8 half.
+    The host random tree is cached per geometry so the int8 and fp8
+    points pay the 7B host fill once."""
     from megatron_tpu.inference.generation import generate_tokens
     from megatron_tpu.models import presets
     from megatron_tpu.models.params import num_params
@@ -298,8 +304,12 @@ def serving_int8_7b_bench(deadline, cfg=None, B=4, prompt_len=64,
         # quantize on host, then place the int8 tree on-device ONCE —
         # _generate_jit traces params, so numpy leaves would re-transfer
         # ~7 GB inside every (timed) call
+        key = (cfg.hidden_size, cfg.num_layers, cfg.vocab_size,
+               cfg.seq_length)
+        if key not in _SERVING_HOST_CACHE:
+            _SERVING_HOST_CACHE[key] = _host_random_params(cfg)
         params = jax.device_put(
-            quantize_params_for_serving(_host_random_params(cfg)))
+            quantize_params_for_serving(_SERVING_HOST_CACHE[key], mode=mode))
         rng = np.random.default_rng(0)
         prompts = rng.integers(0, cfg.vocab_size, (B, prompt_len)).astype(np.int32)
         lengths = np.full((B,), prompt_len, np.int32)
@@ -318,7 +328,8 @@ def serving_int8_7b_bench(deadline, cfg=None, B=4, prompt_len=64,
             "n_params": num_params(cfg),
             "batch": B, "prompt_len": prompt_len, "new_tokens": new_tokens,
             "decode_tokens_per_sec": round(tps, 1),
-            "weights": "int8 (per-channel symmetric)",
+            "weights": ("int8 (per-channel symmetric)" if mode == "int8"
+                        else "fp8 e4m3 (per-channel amax)"),
         }
     except Exception as e:
         return {"error": str(e)[:300]}
@@ -359,8 +370,11 @@ def moe_dispatch_bench(deadline, peak):
 def run_extras(deadline, peak, extras):
     """Fill `extras` in place (SIGTERM handler reads it concurrently)."""
     extras["largest_trainable"] = largest_trainable_bench(deadline, peak)
-    extras["serving_int8_7b"] = serving_int8_7b_bench(deadline)
+    # MoE before the serving pair: on a tight window the two 7B serving
+    # runs must not starve the capacity-vs-dropless comparison
     extras["moe_dispatch"] = moe_dispatch_bench(deadline, peak)
+    extras["serving_int8_7b"] = serving_int8_7b_bench(deadline)
+    extras["serving_fp8_7b"] = serving_int8_7b_bench(deadline, mode="fp8")
 
 
 def emit_error(error, detail=None):

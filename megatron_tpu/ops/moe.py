@@ -290,6 +290,7 @@ def moe_block_dropless_ep(
     x: jnp.ndarray,      # [B, S, H] (GSPMD view; B sharded over (data, expert))
     mesh,
     ep: int,
+    include_data: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Dropless dispatch composed with expert parallelism (VERDICT r4 #3).
 
@@ -315,10 +316,18 @@ def moe_block_dropless_ep(
     Transport is ragged_all_to_all on TPU; CPU (and therefore CI) uses an
     all_gather reconstruction with identical math — the ragged path is on
     the on-device capture list.
+
+    include_data: also make the DATA axis manual (tokens divide data x
+    expert). The sort/bincount/scatter then run per-shard with no
+    batch-axis collectives — the "local-sort form" the ep=1 docstring
+    names as the known GSPMD-argsort fix — and the expert exchange stays
+    within each data slice. Requires B % (data*ep) == 0 (the caller
+    guards); the context/tensor axes stay auto by design (tensor carries
+    the in-expert TP GEMM sharding GSPMD already handles).
     """
     from jax.sharding import PartitionSpec as P
 
-    from megatron_tpu.parallel.mesh import AXIS_EXPERT
+    from megatron_tpu.parallel.mesh import AXIS_DATA, AXIS_EXPERT
 
     E = cfg.num_experts
     k = cfg.moe_top_k
@@ -417,24 +426,28 @@ def moe_block_dropless_ep(
         y = (jnp.zeros((n, h), jnp.float32)
              .at[rows].add(back.astype(jnp.float32) * w[:, None]))
 
+        stat_axes = ((AXIS_DATA, AXIS_EXPERT) if include_data
+                     else AXIS_EXPERT)
         frac = jax.lax.pmean(
             jnp.mean(jax.nn.one_hot(topi[:, 0], E, dtype=jnp.float32),
-                     axis=0), AXIS_EXPERT)
-        prob = jax.lax.pmean(jnp.mean(gates, axis=0), AXIS_EXPERT)
+                     axis=0), stat_axes)
+        prob = jax.lax.pmean(jnp.mean(gates, axis=0), stat_axes)
         z_sq = jax.lax.pmean(
-            jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2), AXIS_EXPERT)
+            jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2), stat_axes)
         aux = _aux_from_stats(cfg, frac, prob, z_sq)
         return y.astype(xb.dtype).reshape(b, s, h), aux
 
     zeros_b = jnp.zeros((E, 0), x.dtype)
+    batch_axes = (AXIS_DATA, AXIS_EXPERT) if include_data else AXIS_EXPERT
     fn = jax.shard_map(
         local_fn,
         mesh=mesh,
-        in_specs=(P(AXIS_EXPERT, None, None), P(None, None),
+        in_specs=(P(batch_axes, None, None), P(None, None),
                   P(AXIS_EXPERT, None, None), P(AXIS_EXPERT, None, None),
                   P(AXIS_EXPERT, None), P(AXIS_EXPERT, None)),
-        out_specs=(P(AXIS_EXPERT, None, None), P()),
-        axis_names={AXIS_EXPERT},
+        out_specs=(P(batch_axes, None, None), P()),
+        axis_names={AXIS_DATA, AXIS_EXPERT} if include_data
+        else {AXIS_EXPERT},
         check_vma=False,
     )
     y, aux = fn(x, p["router"], p["w_in"], p["w_out"],
@@ -442,16 +455,21 @@ def moe_block_dropless_ep(
     return y, aux
 
 
-def _ambient_ep() -> int:
-    """Expert-axis size of the ambient mesh (1 when no mesh is set)."""
+def _ambient_batch_axes() -> Tuple[int, int, bool]:
+    """(data size, expert size, both-axes-present) for the ambient mesh.
+    The presence flag guards out-of-tree meshes missing one of the named
+    batch axes — the shard_map path references BOTH axis names, so it
+    must not be entered on such a mesh (build_mesh always creates all
+    five)."""
     from jax.sharding import get_abstract_mesh
 
-    from megatron_tpu.parallel.mesh import AXIS_EXPERT
+    from megatron_tpu.parallel.mesh import AXIS_DATA, AXIS_EXPERT
 
     mesh = get_abstract_mesh()
     if mesh is None or not mesh.shape:
-        return 1
-    return mesh.shape.get(AXIS_EXPERT, 1)
+        return 1, 1, False
+    both = AXIS_DATA in mesh.shape and AXIS_EXPERT in mesh.shape
+    return mesh.shape.get(AXIS_DATA, 1), mesh.shape.get(AXIS_EXPERT, 1), both
 
 
 def moe_block(
@@ -461,11 +479,15 @@ def moe_block(
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Returns (y [B,S,H], aux_loss scalar fp32)."""
     if cfg.moe_dispatch == "dropless":
-        ep = _ambient_ep()
-        if ep > 1:
-            # mesh=None: shard_map picks up the ambient mesh the ep size
-            # was just read from
-            return moe_block_dropless_ep(cfg, p, x, None, ep)
+        dsz, ep, named_axes = _ambient_batch_axes()
+        # manual data axis (per-shard local sort, no batch-axis argsort
+        # collectives) whenever the batch divides it; ep > 1 always takes
+        # the exchange path. mesh=None: shard_map uses the ambient mesh
+        # the sizes were just read from.
+        include_data = dsz > 1 and x.shape[0] % (dsz * ep) == 0
+        if named_axes and (ep > 1 or include_data):
+            return moe_block_dropless_ep(cfg, p, x, None, ep,
+                                         include_data=include_data)
         return moe_block_dropless(cfg, p, x)
     b, s, h = x.shape
     N = b * s

@@ -1,7 +1,7 @@
 """int8 / fp8 weight-only quantization for serving (beyond the reference;
-the fp8 form is this stack's answer to the reference's optional
+the serving-side half of this stack's answer to the reference's optional
 TransformerEngine fp8 path, megatron/model/transformer.py:962-1043 —
-serving-side only; fp8 *training* remains out of scope).
+fp8 *training* GEMMs live in ops/fp8.py).
 
 Both halve parameter HBM so models that don't fit in bf16 serve on one
 chip (Llama-2-7B: 14 GB bf16 vs ~7 GB quantized on a 16 GB v5e, leaving

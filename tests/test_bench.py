@@ -149,8 +149,8 @@ def test_bench_extras_ride_in_detail(monkeypatch):
     orig = bench.serving_int8_7b_bench
     monkeypatch.setattr(
         bench, "serving_int8_7b_bench",
-        lambda deadline: orig(deadline, cfg=tiny, B=2, prompt_len=8,
-                              new_tokens=4))
+        lambda deadline, **kw: orig(deadline, cfg=tiny, B=2, prompt_len=8,
+                                    new_tokens=4, **kw))
     buf = io.StringIO()
     with redirect_stdout(buf):
         bench.main()
@@ -160,6 +160,9 @@ def test_bench_extras_ride_in_detail(monkeypatch):
     sv = out["detail"]["serving_int8_7b"]
     assert sv["decode_tokens_per_sec"] > 0
     assert sv["weights"].startswith("int8")
+    fp8 = out["detail"]["serving_fp8_7b"]
+    assert fp8["decode_tokens_per_sec"] > 0
+    assert fp8["weights"].startswith("fp8")
 
 
 def test_bench_quick_mode(monkeypatch):
