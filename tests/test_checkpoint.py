@@ -91,6 +91,44 @@ def test_cross_topology_restore(tmp_path):
         np.asarray(jax.device_get(state.params["layers"]["attn"]["wq"])))
 
 
+def test_cross_topology_restore_expert_axis(tmp_path):
+    """MoE checkpoints are topology-free across the EXPERT axis too:
+    save unsharded, restore onto ep2 x tp2 (expert weights sharded E/ep,
+    ZeRO-1 over the combined batch axes), then back onto a dp-only mesh —
+    expert weights exact both ways."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from megatron_tpu.training.optimizer import train_state_specs
+
+    cfg = presets.tiny(vocab_size=64, seq_length=16, num_experts=4,
+                       moe_top_k=2, ffn_hidden_size=32)
+    params = init_params(cfg, jax.random.PRNGKey(3))
+    state = init_train_state(OptimizerConfig(lr=1e-3), params)
+    save = str(tmp_path / "moe_ckpt")
+    checkpointing.save_checkpoint(save, state, iteration=1)
+    ref_w = np.asarray(jax.device_get(params["layers"]["moe"]["w_in"]))
+
+    for par in (ParallelConfig(expert_parallel=2, tensor_parallel=2),
+                ParallelConfig()):
+        rt = build_mesh(par)
+        specs = param_specs(cfg)
+        sharded = shard_tree(rt, init_params(cfg, jax.random.PRNGKey(9)),
+                             specs)
+        template = init_train_state(OptimizerConfig(lr=1e-3), sharded)
+        st_specs = train_state_specs(specs, sharded, rt.dp, zero1=True,
+                                     ep=rt.ep)
+        shardings = jax.tree.map(
+            lambda s: NamedSharding(rt.mesh, s), st_specs,
+            is_leaf=lambda s: isinstance(s, P))
+        restored, _, _ = checkpointing.load_checkpoint(
+            save, template, shardings=shardings)
+        w = restored.params["layers"]["moe"]["w_in"]
+        if rt.ep > 1:
+            assert "expert" in str(w.sharding.spec)
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(w)), ref_w)
+
+
 def test_missing_checkpoint_raises(tmp_path):
     _, template = _state()
     with pytest.raises(FileNotFoundError):

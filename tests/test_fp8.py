@@ -155,6 +155,44 @@ def test_fp8_training_tracks_bf16():
         assert abs(a - b) / b < 0.05, (a, b)
 
 
+def test_fp8_exact_under_tensor_parallel_sharding():
+    """fp8 GEMMs compose with GSPMD sharding: the per-tensor amax is a
+    global reduction over the sharded weight, so tp2 x dp loss and grads
+    equal the unsharded run exactly (fp32 params on CPU)."""
+    from jax.sharding import NamedSharding
+    from megatron_tpu.config import ParallelConfig
+    from megatron_tpu.models import presets
+    from megatron_tpu.models.language_model import lm_loss
+    from megatron_tpu.models.params import init_params, param_specs
+    from megatron_tpu.parallel.mesh import build_mesh
+    from megatron_tpu.parallel.sharding import batch_spec, shard_tree
+
+    cfg = presets.tiny(vocab_size=128, seq_length=32, hidden_size=64,
+                       num_layers=2, num_attention_heads=4,
+                       ffn_hidden_size=128, params_dtype="float32",
+                       fp8_format="hybrid")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    batch = {"tokens": jnp.asarray(rng.integers(0, 128, (4, 32)), jnp.int32),
+             "labels": jnp.asarray(rng.integers(0, 128, (4, 32)), jnp.int32),
+             "loss_mask": jnp.ones((4, 32), jnp.float32)}
+    l_ref, g_ref = jax.value_and_grad(
+        lambda p: lm_loss(cfg, p, batch)[0])(params)
+
+    rt = build_mesh(ParallelConfig(tensor_parallel=2,
+                                   sequence_parallel=True))
+    sp = shard_tree(rt, params, param_specs(cfg))
+    sb = {k: jax.device_put(v, NamedSharding(rt.mesh, batch_spec()))
+          for k, v in batch.items()}
+    with jax.sharding.set_mesh(rt.mesh):
+        l_tp, g_tp = jax.jit(jax.value_and_grad(
+            lambda p, b: lm_loss(cfg, p, b)[0]))(sp, sb)
+    np.testing.assert_allclose(float(l_tp), float(l_ref), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(g_tp), jax.tree.leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
+
+
 def test_fp8_cli_flags():
     from megatron_tpu.arguments import args_to_run_config, parse_args
 
