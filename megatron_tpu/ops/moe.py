@@ -204,6 +204,13 @@ def _excl_cumsum(x, axis=0):
     return jnp.cumsum(x, axis=axis) - x
 
 
+def _use_ragged_transport() -> bool:
+    """ragged_all_to_all has no XLA:CPU thunk; tests monkeypatch this to
+    force the ragged path through an emulated primitive (so its metadata
+    and custom VJP are CI-covered before the one-shot TPU window)."""
+    return jax.default_backend() == "tpu"
+
+
 def _ep_metadata(counts, me, ep: int, El: int, R: int):
     """All transfer bookkeeping for the expert all-to-all, derived from the
     all-gathered per-(source shard, global expert) counts matrix.
@@ -358,7 +365,7 @@ def moe_block_dropless_ep(
         md = _ep_metadata(counts, me, ep, El, R)
 
         # ---- dispatch: send each expert's rows to its owner ----------
-        use_ragged = jax.default_backend() == "tpu"
+        use_ragged = _use_ragged_transport()
         if use_ragged:
             recv_buf = _ragged_exchange(
                 xs, R, md["in_off"], md["send"], md["out_off"], md["recv"],
