@@ -71,13 +71,10 @@ def attention(
     if impl in ("ring", "ulysses"):
         # context-parallel exact attention; requires an ambient mesh with a
         # "context" axis (jax.sharding.set_mesh) and no dropout/padding
-        from jax.sharding import get_abstract_mesh
+        from megatron_tpu.parallel.mesh import (AXIS_CONTEXT,
+                                                ambient_mesh_shape)
 
-        from megatron_tpu.parallel.mesh import AXIS_CONTEXT
-
-        mesh = get_abstract_mesh()
-        cp = (mesh.shape.get(AXIS_CONTEXT, 1)
-              if mesh is not None and mesh.shape else 1)
+        cp = ambient_mesh_shape().get(AXIS_CONTEXT, 1)
         can_use = (dropout == 0.0 and padding_mask is None
                    and q.shape[1] == k.shape[1]
                    and q.shape[1] % max(cp, 1) == 0)

@@ -42,6 +42,8 @@ path. The real-hardware probe is on the tunnel capture list
 
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
 
@@ -104,8 +106,6 @@ def fp8_matmul(x: jnp.ndarray, w: jnp.ndarray, fmt: str = "hybrid",
             preferred_element_type=jnp.float32)
         dx = (dx / (sg * sw)).astype(out_dtype)
         # dw = x^T @ g : contract all leading (batch) dims
-        import math
-
         m = math.prod(x8.shape[:-1])
         x2 = x8.reshape(m, x8.shape[-1])
         if fp8_wgrad:

@@ -84,6 +84,19 @@ class MeshRuntime:
         return NamedSharding(self.mesh, P())
 
 
+def ambient_mesh_shape() -> dict:
+    """Axis-name -> size of the ambient mesh (jax.sharding.set_mesh /
+    `with mesh:`), or {} when none is set. THE accessor for ops that
+    adapt to the mesh they run under (moe dispatch, attention CP guard),
+    so the get_abstract_mesh handling lives in one place."""
+    from jax.sharding import get_abstract_mesh
+
+    mesh = get_abstract_mesh()
+    if mesh is None or not mesh.shape:
+        return {}
+    return dict(mesh.shape)
+
+
 def build_mesh(
     parallel: ParallelConfig,
     devices: Optional[Sequence[jax.Device]] = None,
