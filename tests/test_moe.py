@@ -567,16 +567,20 @@ def test_moe_trains_with_dedicated_expert_axis():
     assert any("lm loss" in l for l in logs)
 
 
-def test_moe_pipeline_matches_unpipelined():
-    """pp2 x MoE: pipelined loss (CE + router aux accumulated across
-    stages into the last-stage total) equals the per-microbatch-averaged
-    unpipelined MoE loss. The aux term is batch-composition-dependent
-    (frac*prob is nonlinear in the token set), so the honest reference is
-    the microbatched unpipelined path, not one full-batch forward."""
+@pytest.mark.parametrize("dispatch", ["capacity", "dropless"])
+def test_moe_pipeline_matches_unpipelined(dispatch):
+    """pp2 x MoE (both dispatch modes): pipelined loss (CE + router aux
+    accumulated across stages into the last-stage total) equals the
+    per-microbatch-averaged unpipelined MoE loss. The aux term is
+    batch-composition-dependent (frac*prob is nonlinear in the token
+    set), so the honest reference is the microbatched unpipelined path,
+    not one full-batch forward. Dropless inside the pipe shard_map falls
+    back to the GSPMD form (microbatches don't divide the batch axes) —
+    pinned here so the guard keeps composing with pp."""
     from megatron_tpu.parallel.mesh import build_mesh
     from megatron_tpu.training.pipeline import make_pipeline_loss_fn
 
-    cfg = _moe_cfg()
+    cfg = _moe_cfg(moe_dispatch=dispatch)
     params = init_params(cfg, jax.random.PRNGKey(0))
     rng = np.random.default_rng(0)
     M, mbs = 2, 2
