@@ -480,11 +480,16 @@ def moe_block(
     if cfg.moe_dispatch == "dropless":
         dsz, ep, named_axes = _ambient_batch_axes()
         # manual data axis (per-shard local sort, no batch-axis argsort
-        # collectives) whenever the batch divides it; ep > 1 always takes
-        # the exchange path. mesh=None: shard_map uses the ambient mesh
-        # the sizes were just read from.
+        # collectives) whenever the batch divides it; ep > 1 takes the
+        # exchange path whenever the batch divides the expert axis.
+        # Batches that divide neither (single-row decode on an ep mesh)
+        # fall back to the GSPMD form — correct against expert-sharded
+        # weights (the partitioner gathers them), just not manual.
+        # mesh=None: shard_map uses the ambient mesh the sizes were just
+        # read from.
         include_data = dsz > 1 and x.shape[0] % (dsz * ep) == 0
-        if named_axes and (ep > 1 or include_data):
+        ep_ok = ep > 1 and x.shape[0] % ep == 0
+        if named_axes and (ep_ok or include_data):
             return moe_block_dropless_ep(cfg, p, x, None, ep,
                                          include_data=include_data)
         return moe_block_dropless(cfg, p, x)
