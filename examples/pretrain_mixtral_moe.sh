@@ -4,9 +4,11 @@
 # (--expert_model_parallel_size, decoupled from dp — the expert count
 # never constrains the data-parallel degree) and tensor-parallel inside
 # each expert; top-2 renormalized routing with the Switch load-balance
-# loss. For single-group runs, --moe_dispatch dropless swaps the GShard
-# capacity einsums for sort-based lax.ragged_dot grouped GEMMs (no token
-# drops, no dense dispatch FLOPs).
+# loss. --moe_dispatch dropless swaps the GShard capacity einsums for
+# sort-based lax.ragged_dot grouped GEMMs — no token drops, no dense
+# dispatch FLOPs — and composes with ep > 1 via an explicit expert-axis
+# ragged all-to-all (per-shard local sort, default receive buffer exactly
+# dropless; --moe_ep_buffer_factor trades FLOPs vs worst-case buffers).
 #
 # On a v5p-128 slice: tp8 x ep8 x dp2 — one expert per ep rank.
 
@@ -18,7 +20,7 @@ python pretrain_gpt.py \
     --use_distributed_optimizer \
     --num_experts 8 \
     --moe_top_k 2 \
-    --moe_capacity_factor 1.25 \
+    --moe_dispatch dropless \
     --moe_aux_loss_coeff 0.01 \
     --micro_batch_size 1 \
     --global_batch_size 256 \
