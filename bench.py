@@ -1,7 +1,8 @@
 """Benchmark: llama-architecture training-step MFU on one TPU chip.
 
-Prints one JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Prints one JSON line per metric, the headline last:
+  {"metric": "serve_decode_throughput_toks_per_s", ...}   (full runs)
+  {"metric": "llama_train_step_mfu", "value": N, ...}     (always, LAST)
 
 Method: jitted full training step (fwd + bwd + Adam with fp32 masters,
 selective recompute, bf16 compute) on a llama-family model sized to fit one
@@ -335,6 +336,90 @@ def serving_int8_7b_bench(deadline, cfg=None, B=4, prompt_len=64,
         return {"error": str(e)[:300]}
 
 
+def serving_engine_bench(deadline, num_slots=4, prompt_len=8, new_tokens=24):
+    """Offered-load continuous-batching throughput: submit num_slots
+    concurrent requests to an InferenceEngine (inference/engine.py) and
+    time the drain against handling the same requests sequentially
+    through generate_tokens — one shared jitted batched decode step vs a
+    per-request loop. Returns the full metric line; vs_baseline is the
+    speedup over sequential handling (> 1 = continuous batching wins, and
+    it grows with concurrency until the chip saturates). Geometry rides
+    on headline_config so hermetic tests stay tiny."""
+    line = {"metric": "serve_decode_throughput_toks_per_s", "value": 0.0,
+            "unit": "tokens_per_sec", "vs_baseline": 0.0}
+    if deadline - time.perf_counter() < 30:
+        line["error"] = "budget_exhausted"
+        return line
+    try:
+        import jax
+
+        from megatron_tpu.inference.engine import InferenceEngine
+        from megatron_tpu.inference.generation import generate_tokens
+        from megatron_tpu.models.params import init_params
+
+        cfg = headline_config()
+        if jax.default_backend() == "cpu" and cfg.hidden_size > 512:
+            # CPU runs are recipe/sanity runs (docs/serving.md): the 640M
+            # headline geometry takes longer than the whole budget host-
+            # side, so shrink to a llama-shaped model that finishes in
+            # seconds; the TPU number is the real metric
+            from megatron_tpu.models import presets
+
+            cfg = presets.tiny(
+                vocab_size=8192, seq_length=256, hidden_size=256,
+                num_layers=4, num_attention_heads=8, num_kv_heads=8,
+                ffn_hidden_size=512, params_dtype="float32")
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        rng = np.random.default_rng(0)
+        prompts = rng.integers(
+            0, cfg.vocab_size, (num_slots, prompt_len)).astype(np.int32)
+        lengths = np.full((num_slots,), prompt_len, np.int32)
+        eng = InferenceEngine(cfg, params, num_slots=num_slots,
+                              max_seq_len=min(cfg.seq_length, 128))
+
+        # warmup compiles both paths: the engine's prefill bucket + the
+        # one batched decode step, and the baseline's generate loop
+        eng.generate(prompts[:1], lengths[:1], max_new_tokens=new_tokens)
+        generate_tokens(cfg, params, prompts[:1], lengths[:1],
+                        max_new_tokens=new_tokens, temperature=0.0,
+                        want_logprobs=False)
+
+        t0 = time.perf_counter()
+        for i in range(num_slots):
+            generate_tokens(cfg, params, prompts[i:i + 1], lengths[i:i + 1],
+                            max_new_tokens=new_tokens, temperature=0.0,
+                            want_logprobs=False)
+        t_seq = max(time.perf_counter() - t0, 1e-9)
+
+        def compiles():
+            try:  # jitted-fn cache size = number of distinct compiles
+                return int(eng._decode_step._cache_size())
+            except Exception:  # noqa: BLE001 - diagnostics only
+                return -1
+
+        warm = compiles()
+        t0 = time.perf_counter()
+        eng.generate(prompts, lengths, max_new_tokens=new_tokens)
+        t_eng = max(time.perf_counter() - t0, 1e-9)
+
+        tps = num_slots * new_tokens / t_eng
+        line.update(
+            value=round(tps, 1),
+            vs_baseline=round(t_seq / t_eng, 3),
+            detail={
+                "num_slots": num_slots, "prompt_len": prompt_len,
+                "new_tokens": new_tokens,
+                "engine_drain_s": round(t_eng, 4),
+                "sequential_s": round(t_seq, 4),
+                "decode_recompiles_after_warmup": (
+                    compiles() - warm if warm >= 0 else -1),
+                "hidden": cfg.hidden_size, "layers": cfg.num_layers,
+            })
+    except Exception as e:  # noqa: BLE001 - the metric line must emit
+        line["error"] = str(e)[:300]
+    return line
+
+
 def moe_dispatch_bench(deadline, peak):
     """Iso-parameter 4-expert/top-2 MoE at the headline geometry, capacity
     vs dropless dispatch MFU (useful-FLOP accounting like
@@ -433,6 +518,12 @@ def main():
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception as e:  # noqa: BLE001 - cache is best-effort
         print(f"# compilation cache unavailable: {e}", file=sys.stderr)
+
+    if os.environ.get("MEGATRON_TPU_BENCH_SERVING_ONLY"):
+        # local recipe (docs/serving.md): just the serving metric, skip
+        # the multi-minute training-step search. Never set by the driver.
+        print(json.dumps(serving_engine_bench(deadline)), flush=True)
+        return
 
     from megatron_tpu.models.params import num_params
     from megatron_tpu.platform import peak_bf16_flops
@@ -551,6 +642,12 @@ def main():
     # from here on `best` exists: nothing post-search (extras, profiler) may
     # cost the round its number
     try:
+        if not quick:
+            # serving metric rides as its own JSON line BEFORE the headline
+            # (and before any extras lines — the only positional contract
+            # is that the headline MFU line comes LAST for the driver;
+            # consumers of the serving metric must match on "metric")
+            print(json.dumps(serving_engine_bench(deadline)), flush=True)
         if want_extras:
             run_extras(deadline, peak, extras)
 

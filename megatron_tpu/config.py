@@ -249,6 +249,14 @@ class ModelConfig:
                     "'capacity' or 'dropless'")
             if self.moe_group_size < 0:
                 raise ValueError("moe_group_size must be >= 0")
+            if (self.moe_ep_buffer_factor is not None
+                    and self.moe_ep_buffer_factor <= 0):
+                # <= 0 would zero every shard's receive buffer and the MoE
+                # layer would silently drop every routed token (ADVICE r5
+                # low #2)
+                raise ValueError(
+                    f"moe_ep_buffer_factor={self.moe_ep_buffer_factor} "
+                    "must be > 0 (None = exact dropless)")
             if self.moe_group_size and self.seq_length % self.moe_group_size:
                 raise ValueError(
                     f"moe_group_size={self.moe_group_size} must divide "

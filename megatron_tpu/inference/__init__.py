@@ -1,4 +1,4 @@
-from megatron_tpu.inference.sampling import sample_logits
+from megatron_tpu.inference.sampling import sample_logits, sample_logits_batched
 from megatron_tpu.inference.generation import (
     GenerationOutput,
     generate_tokens,
@@ -9,13 +9,17 @@ from megatron_tpu.inference.api import (
     generate_and_post_process,
     beam_search_and_post_process,
 )
+from megatron_tpu.inference.engine import InferenceEngine, Request
 
 __all__ = [
     "sample_logits",
+    "sample_logits_batched",
     "GenerationOutput",
     "generate_tokens",
     "score_tokens",
     "beam_search_tokens",
     "generate_and_post_process",
     "beam_search_and_post_process",
+    "InferenceEngine",
+    "Request",
 ]

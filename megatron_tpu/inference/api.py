@@ -56,10 +56,14 @@ def generate_and_post_process(
     random_seed: int = 0,
     forward_fn=None,
     kv_cache_int8: bool = False,
+    engine=None,
 ):
     """(texts, segments, logprobs, tokens) like the reference's
     generate_and_post_process (api.py:19-90). forward_fn plugs in the
-    pipelined pp>1 forward (inference/pipelined.py)."""
+    pipelined pp>1 forward (inference/pipelined.py); engine routes the
+    request through a continuous-batching InferenceEngine
+    (inference/engine.py) instead of the one-shot generate_tokens — its
+    slot scheduler lets concurrent callers share decode steps."""
     if tokens_to_generate < 0:
         raise ValueError("tokens_to_generate must be >= 0")
     prompt_tokens, lengths = tokenize_prompts(tokenizer, prompts,
@@ -70,13 +74,30 @@ def generate_and_post_process(
         texts = [tokenizer.detokenize(t[:l]) for t, l in zip(prompt_tokens, lengths)]
         return texts, None, lp, prompt_tokens
 
-    out = generate_tokens(
-        cfg, params, prompt_tokens, lengths,
-        max_new_tokens=tokens_to_generate,
-        temperature=temperature, top_k=top_k_sampling, top_p=top_p_sampling,
-        vocab_size=tokenizer.vocab_size, eod=tokenizer.eod, seed=random_seed,
-        want_logprobs=return_output_log_probs, forward_fn=forward_fn,
-        kv_cache_int8=kv_cache_int8)
+    if engine is not None:
+        # the engine owns its own forward and cache configuration — a
+        # conflicting request must fail loudly, not be silently dropped
+        if forward_fn is not None:
+            raise ValueError(
+                "engine= and forward_fn= are mutually exclusive (the "
+                "continuous-batching engine runs the single-stage forward)")
+        if bool(kv_cache_int8) != bool(engine.kv_cache_int8):
+            raise ValueError(
+                f"kv_cache_int8={kv_cache_int8} conflicts with the "
+                f"engine's kv_cache_int8={engine.kv_cache_int8} — the "
+                "cache mode is fixed when the engine is built")
+        out = engine.generate(
+            prompt_tokens, lengths, max_new_tokens=tokens_to_generate,
+            temperature=temperature, top_k=top_k_sampling,
+            top_p=top_p_sampling, eod=tokenizer.eod, seed=random_seed)
+    else:
+        out = generate_tokens(
+            cfg, params, prompt_tokens, lengths,
+            max_new_tokens=tokens_to_generate,
+            temperature=temperature, top_k=top_k_sampling, top_p=top_p_sampling,
+            vocab_size=tokenizer.vocab_size, eod=tokenizer.eod, seed=random_seed,
+            want_logprobs=return_output_log_probs, forward_fn=forward_fn,
+            kv_cache_int8=kv_cache_int8)
 
     texts, segments = [], []
     for row, end in zip(out.tokens, out.lengths):

@@ -1,0 +1,520 @@
+"""Continuous-batching decode engine with a persistent slot-based KV cache.
+
+The one-shot path (generation.py) allocates a dense [B, L, S, H] cache per
+call and serves one request at a time — decode utilization collapses to a
+single sequence's matmul. This engine owns ONE long-lived cache shaped
+[L, num_slots, S, H, D] (optionally int8, ops/kv_quant.py) and runs a step
+loop: every tick it admits queued requests into free slots (a bucketed
+prefill writes the slot's rows) and then executes ONE batched single-token
+decode for all slots — one jit-compiled step reused across traffic, no
+recompiles after warmup. Sequences of different ages coexist because the
+attention path masks each slot to its own valid prefix (per-slot lengths;
+ops/attention.py kv_lengths, Pallas flash-decode on TPU).
+
+Per-request sampling params (temperature/top_k/top_p) are traced [N]
+arrays, not static — heterogeneous traffic shares the same compiled step
+(sampling.sample_logits_batched). Each request carries its own PRNG chain
+keyed off its seed, so a request's tokens never depend on which other
+slots happen to be active (the interleaved-traffic parity invariant;
+tests/test_serving_engine.py).
+
+Greedy parity gate: a single request decoded through the engine is
+token-identical to generation.generate_tokens — prefill logits come from
+the same bucketed causal pass, and masking a decode step to the valid
+prefix contributes exact zeros to the softmax, so the math matches
+bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+import threading
+from collections import deque
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megatron_tpu.config import ModelConfig
+from megatron_tpu.inference.generation import GenerationOutput, _init_caches
+from megatron_tpu.inference.sampling import sample_logits_batched
+
+
+@dataclasses.dataclass
+class Request:
+    """One sequence's lifecycle through the engine."""
+    prompt: np.ndarray                 # [p] int32 token ids
+    max_new_tokens: int
+    temperature: float = 0.0           # 0 = greedy
+    top_k: int = 0
+    top_p: float = 0.0
+    eod: Optional[int] = None
+    seed: int = 0
+    # engine-filled
+    generated: List[int] = dataclasses.field(default_factory=list)
+    logprobs: List[float] = dataclasses.field(default_factory=list)
+    # teacher-forced logprobs of prompt[1:] from the admission prefill
+    # (the one-shot path returns these too; generation.py:136-141)
+    prompt_logprobs: List[float] = dataclasses.field(default_factory=list)
+    done: threading.Event = dataclasses.field(default_factory=threading.Event)
+    error: Optional[str] = None
+
+    @property
+    def tokens(self) -> np.ndarray:
+        """prompt + generated (eod included when emitted)."""
+        return np.concatenate(
+            [np.asarray(self.prompt, np.int32),
+             np.asarray(self.generated, np.int32)])
+
+    def _finish(self, error: Optional[str] = None):
+        self.error = error
+        self.done.set()
+
+
+class InferenceEngine:
+    """Slot scheduler + jitted prefill/decode steps over one shared cache.
+
+    Not thread-safe for concurrent step() calls; submit() may be called
+    from any thread (the HTTP handlers), step()/run_until_idle() from one
+    driver thread (start() spawns it).
+    """
+
+    def __init__(self, cfg: ModelConfig, params: Any, num_slots: int = 8,
+                 max_seq_len: Optional[int] = None,
+                 kv_cache_int8: bool = False, prefill_bucket: int = 64,
+                 vocab_size: Optional[int] = None, mesh=None,
+                 want_logprobs: bool = True):
+        if num_slots < 1:
+            raise ValueError("num_slots must be >= 1")
+        self.cfg = cfg
+        self.params = params
+        self.num_slots = num_slots
+        self.max_seq_len = int(max_seq_len or cfg.seq_length)
+        if (cfg.position_embedding_type == "absolute"
+                and self.max_seq_len > (cfg.max_position_embeddings or 0)):
+            raise ValueError(
+                f"max_seq_len {self.max_seq_len} exceeds "
+                f"max_position_embeddings {cfg.max_position_embeddings}")
+        self.kv_cache_int8 = kv_cache_int8
+        self.prefill_bucket = prefill_bucket
+        self.vocab_size = vocab_size
+        self.mesh = mesh
+        self.want_logprobs = want_logprobs
+
+        N = num_slots
+        self.caches = _init_caches(cfg, N, self.max_seq_len,
+                                   int8=kv_cache_int8)
+        self.slots: List[Optional[Request]] = [None] * N
+        self.lengths = np.zeros(N, np.int32)    # valid context per slot
+        self.last_tok = np.zeros(N, np.int32)   # sampled, not yet in cache
+        self.temps = np.zeros(N, np.float32)
+        self.top_ks = np.zeros(N, np.int32)
+        self.top_ps = np.zeros(N, np.float32)
+        self.keys = np.zeros((N, 2), np.uint32)
+
+        self._queue: deque[Request] = deque()
+        self._cv = threading.Condition()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = False
+        # device-resident decode carry (last_tok, lengths, keys, temps,
+        # top_ks, top_ps): steady-state ticks chain device arrays instead
+        # of re-uploading 6 host arrays per token; admission events
+        # invalidate it (None -> re-upload from the host mirrors)
+        self._carry = None
+
+        self._decode_step = self._build_decode_step()
+        self._prefill_steps = {}  # bucketed prompt length -> jitted fn
+        # observability for tests/metrics: monotonically-growing counters
+        self.stats = {"admitted": 0, "retired": 0, "ticks": 0,
+                      "rejected": 0}
+
+    # ----- jitted device steps --------------------------------------------
+
+    def _donate(self):
+        # donate the persistent cache so each step updates it in place
+        # (the whole point of a slot cache); XLA:CPU can't donate and
+        # would warn every compile
+        return (1,) if jax.default_backend() != "cpu" else ()
+
+    def _build_decode_step(self):
+        cfg, vocab, wlp = self.cfg, self.vocab_size, self.want_logprobs
+        from functools import partial
+
+        from megatron_tpu.models.language_model import lm_forward
+
+        @partial(jax.jit, donate_argnums=self._donate())
+        def decode_step(params, caches, last_tok, lengths, keys, temps,
+                        top_ks, top_ps):
+            # one batched token for every slot: write K/V at each slot's
+            # own position, attend each slot's own valid prefix
+            logits, caches = lm_forward(cfg, params, last_tok[:, None],
+                                        kv_caches=caches,
+                                        cache_index=lengths)
+            logits = logits[:, 0]
+            split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+            new_keys, subs = split[:, 0], split[:, 1]
+            toks = sample_logits_batched(logits, subs, temps, top_ks,
+                                         top_ps, vocab)
+            if wlp:
+                lp = jnp.take_along_axis(
+                    jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1),
+                    toks[:, None], axis=-1)[:, 0]
+            else:
+                lp = jnp.zeros(toks.shape, jnp.float32)
+            # toks/lengths+1 re-enter the next tick as the carry
+            return toks, lp, caches, new_keys, lengths + 1
+
+        return decode_step
+
+    def _prefill_step(self, P: int):
+        """Jitted prefill at static bucket length P (compiled once per
+        bucket; nearby prompt lengths share a compile)."""
+        fn = self._prefill_steps.get(P)
+        if fn is not None:
+            return fn
+        cfg, int8, vocab = self.cfg, self.kv_cache_int8, self.vocab_size
+        wlp = self.want_logprobs
+        from functools import partial
+
+        from megatron_tpu.models.language_model import lm_forward
+
+        @partial(jax.jit, donate_argnums=self._donate())
+        def prefill(params, caches, tokens, length, slot, key, temp,
+                    top_k, top_p):
+            small = _init_caches(cfg, 1, P, int8=int8)
+            logits, small = lm_forward(cfg, params, tokens,
+                                       positions=jnp.arange(P)[None, :],
+                                       kv_caches=small, cache_index=0)
+
+            def paste(big, sm):
+                idx = (0, slot) + (0,) * (big.ndim - 2)
+                return jax.lax.dynamic_update_slice(
+                    big, sm.astype(big.dtype), idx)
+
+            caches = jax.tree.map(paste, caches, small)
+            last = jnp.take_along_axis(
+                logits, jnp.full((1, 1, 1), length - 1), axis=1)[:, 0]
+            key, sub = jax.random.split(key)
+            tok = sample_logits_batched(last, sub[None], temp[None],
+                                        top_k[None], top_p[None], vocab)[0]
+            if wlp:
+                lp = jnp.take_along_axis(
+                    jax.nn.log_softmax(last.astype(jnp.float32), axis=-1),
+                    tok[None, None], axis=-1)[0, 0]
+                # teacher-forced prompt logprobs (positions 1..P-1), like
+                # the one-shot path; the caller slices to the real length
+                plp = jnp.take_along_axis(
+                    jax.nn.log_softmax(
+                        logits[0, :P - 1].astype(jnp.float32), axis=-1),
+                    tokens[0, 1:, None], axis=-1)[:, 0]
+            else:
+                lp = jnp.zeros((), jnp.float32)
+                plp = jnp.zeros((P - 1,), jnp.float32)
+            return tok, lp, plp, caches, key
+
+        self._prefill_steps[P] = prefill
+        return prefill
+
+    # ----- scheduling ------------------------------------------------------
+
+    def submit(self, req: Request) -> Request:
+        """Queue a request; returns it (wait on req.done)."""
+        p = len(req.prompt)
+        if p == 0:
+            req._finish("empty prompt")
+            return req
+        if req.max_new_tokens < 1:
+            req._finish("max_new_tokens must be >= 1")
+            return req
+        if p + req.max_new_tokens > self.max_seq_len:
+            req._finish(
+                f"prompt ({p}) + max_new_tokens ({req.max_new_tokens}) "
+                f"exceeds engine max_seq_len {self.max_seq_len}")
+            self.stats["rejected"] += 1
+            return req
+        with self._cv:
+            self._queue.append(req)
+            self._cv.notify_all()
+        return req
+
+    @property
+    def num_active(self) -> int:
+        return sum(1 for s in self.slots if s is not None)
+
+    def _bucket(self, p: int) -> int:
+        b = self.prefill_bucket
+        return min(self.max_seq_len - 1, max(1, -(-p // b) * b))
+
+    def _clear_slot(self, i: int):
+        """Reset EVERY per-slot host mirror — a cleared slot must not
+        leave sampling knobs behind, or the next carry upload would keep
+        the batched sampler's filter branch live for stale rows."""
+        self.slots[i] = None
+        self.lengths[i] = 0
+        self.last_tok[i] = 0
+        self.temps[i] = 0.0
+        self.top_ks[i] = 0
+        self.top_ps[i] = 0.0
+
+    def _retire(self, i: int):
+        req = self.slots[i]
+        self._clear_slot(i)
+        self.stats["retired"] += 1
+        # drop the device carry: it still holds this slot's sampling
+        # knobs, and a stale temperature/top_k>0 row would keep the
+        # batched sampler's lax.cond filter branch (the [N, V] sort) live
+        # for every remaining tick
+        self._sync_carry()
+        req._finish()
+
+    def _sync_carry(self):
+        """Pull the device-authoritative decode carry back into the host
+        mirrors and invalidate it (an admission is about to edit rows).
+        last_tok/lengths host mirrors are updated every tick; only the
+        per-slot PRNG chains live solely on device between events."""
+        if self._carry is not None:
+            self.keys = np.array(self._carry[2])
+            self._carry = None
+
+    def _admit(self) -> int:
+        """Move queued requests into free slots; prefill each. Returns the
+        number admitted this tick."""
+        n = 0
+        for i in range(self.num_slots):
+            if self.slots[i] is not None:
+                continue
+            with self._cv:
+                req = self._queue.popleft() if self._queue else None
+            if req is None:
+                break
+            self._sync_carry()
+            p = len(req.prompt)
+            P = self._bucket(p)
+            toks = np.zeros((1, P), np.int32)
+            toks[0, :p] = req.prompt
+            try:
+                tok, lp, plp, caches, key = self._prefill_step(P)(
+                    self.params, self.caches, jnp.asarray(toks),
+                    jnp.int32(p), jnp.int32(i), jax.random.PRNGKey(req.seed),
+                    jnp.float32(req.temperature), jnp.int32(req.top_k),
+                    jnp.float32(req.top_p))
+            except Exception as e:  # noqa: BLE001 - a failing prefill
+                # (fresh-bucket compile OOM etc.) must fail THIS request,
+                # not strand it un-signalled and kill the step loop
+                req._finish(f"prefill failed: {e}")
+                self.stats["rejected"] += 1
+                if self._donate():
+                    # the failed call may have consumed the donated cache
+                    # buffers — continuing would poison every active slot
+                    # at the next decode tick (step() has the matching
+                    # recovery); fail the in-flight requests and restart
+                    # from a fresh cache
+                    for j, other in enumerate(self.slots):
+                        if other is not None:
+                            self._clear_slot(j)
+                            other._finish(f"prefill failed: {e}")
+                    self.caches = _init_caches(self.cfg, self.num_slots,
+                                               self.max_seq_len,
+                                               int8=self.kv_cache_int8)
+                continue
+            self.caches = caches
+            self.slots[i] = req
+            self.lengths[i] = p
+            self.last_tok[i] = int(tok)
+            self.temps[i] = req.temperature
+            self.top_ks[i] = req.top_k
+            self.top_ps[i] = req.top_p
+            self.keys[i] = np.asarray(key)
+            req.generated.append(int(tok))
+            req.logprobs.append(float(lp))
+            req.prompt_logprobs = [float(x) for x in plp[:p - 1]]
+            self.stats["admitted"] += 1
+            n += 1
+            if self._req_finished(req):
+                self._retire(i)
+        return n
+
+    def _req_finished(self, req: Request) -> bool:
+        return (len(req.generated) >= req.max_new_tokens
+                or (req.eod is not None and req.generated
+                    and req.generated[-1] == req.eod))
+
+    def step(self) -> int:
+        """One engine tick: admit into free slots, then one batched decode
+        for every active slot. Returns the number of active slots served
+        (0 = idle)."""
+        self._admit()
+        if self.num_active == 0:
+            return 0
+        active = [i for i, s in enumerate(self.slots) if s is not None]
+        if self._carry is None:
+            self._carry = (jnp.asarray(self.last_tok),
+                           jnp.asarray(self.lengths),
+                           jnp.asarray(self.keys),
+                           jnp.asarray(self.temps),
+                           jnp.asarray(self.top_ks),
+                           jnp.asarray(self.top_ps))
+        last, lens, keys, temps, top_ks, top_ps = self._carry
+        try:
+            toks, lps, caches, keys, lens = self._decode_step(
+                self.params, self.caches, last, lens, keys, temps, top_ks,
+                top_ps)
+        except Exception as e:  # noqa: BLE001 - fail the in-flight
+            # requests (their waiters must unblock) and restore a usable
+            # cache (donation may have consumed the old buffers), then
+            # surface the error to the driver
+            for i in active:
+                req = self.slots[i]
+                self._clear_slot(i)
+                req._finish(f"decode step failed: {e}")
+            self._carry = None
+            self.caches = _init_caches(self.cfg, self.num_slots,
+                                       self.max_seq_len,
+                                       int8=self.kv_cache_int8)
+            raise
+        self.caches = caches
+        # toks/lens/keys chain into the next tick on device; only the
+        # sampled tokens (and logprobs) cross to the host each tick
+        self._carry = (toks, lens, keys, temps, top_ks, top_ps)
+        toks = np.asarray(toks)
+        lps = np.asarray(lps)
+        self.stats["ticks"] += 1
+        for i in active:
+            req = self.slots[i]
+            # the fed token is now in the cache; the sampled one is next up
+            self.lengths[i] += 1
+            tok = int(toks[i])
+            self.last_tok[i] = tok
+            req.generated.append(tok)
+            req.logprobs.append(float(lps[i]))
+            if self._req_finished(req):
+                self._retire(i)
+        return len(active)
+
+    # ----- driving ---------------------------------------------------------
+
+    def _mesh_scope(self):
+        import contextlib
+
+        return (jax.sharding.set_mesh(self.mesh) if self.mesh is not None
+                else contextlib.nullcontext())
+
+    def run_until_idle(self) -> None:
+        """Step until the queue and every slot drain (single-thread use:
+        tests, benches, batch jobs)."""
+        with self._mesh_scope():
+            while True:
+                served = self.step()
+                with self._cv:
+                    if served == 0 and not self._queue:
+                        return
+
+    def generate(self, prompts: np.ndarray, lengths: np.ndarray,
+                 max_new_tokens: int, temperature: float = 0.0,
+                 top_k: int = 0, top_p: float = 0.0,
+                 eod: Optional[int] = None, seed: int = 0
+                 ) -> GenerationOutput:
+        """Batch convenience with generate_tokens' semantics: submit one
+        request per row, drain, and repack [B, maxp+max_new] (rows padded
+        with eod/0 past their end). The one-shot jitted loop runs EVERY
+        row of a ragged batch to maxp + max_new_tokens, so shorter
+        prompts get the difference as extra generated tokens — matched
+        here so flipping a server between engine and one-shot mode never
+        changes a response."""
+        B, maxp = prompts.shape
+        reqs = []
+        for b in range(B):
+            p = int(lengths[b])
+            reqs.append(self.submit(Request(
+                prompt=np.asarray(prompts[b, :p], np.int32),
+                max_new_tokens=maxp - p + max_new_tokens,
+                temperature=temperature,
+                top_k=top_k, top_p=top_p, eod=eod, seed=seed + b)))
+        if self._thread is None:
+            self.run_until_idle()
+        for r in reqs:
+            r.done.wait()
+        errs = [r.error for r in reqs if r.error]
+        if errs:
+            raise ValueError(errs[0])
+        total = maxp + max_new_tokens
+        pad = 0 if eod is None else eod
+        tokens = np.full((B, total), pad, np.int32)
+        ends = np.zeros(B, np.int64)
+        lp = np.zeros((B, total - 1), np.float32)
+        for b, r in enumerate(reqs):
+            t = r.tokens
+            tokens[b, :len(t)] = t
+            ends[b] = len(t)
+            # teacher-forced prompt region then generated tokens, matching
+            # the one-shot path's row layout (lp[i] scores token i+1)
+            lp[b, :len(r.prompt_logprobs)] = r.prompt_logprobs
+            gen0 = int(lengths[b]) - 1  # logprob row index of first token
+            lp[b, gen0:gen0 + len(r.logprobs)] = r.logprobs
+        return GenerationOutput(tokens=tokens, lengths=ends, logprobs=lp)
+
+    # ----- background thread (HTTP serving) --------------------------------
+
+    def start(self) -> None:
+        """Spawn the step-loop thread: concurrent submitters share each
+        decode tick."""
+        if self._thread is not None:
+            return
+        self._stop = False
+
+        def loop():
+            with self._mesh_scope():
+                while True:
+                    with self._cv:
+                        while (not self._stop and self.num_active == 0
+                               and not self._queue):
+                            self._cv.wait()
+                        if self._stop:
+                            return
+                    try:
+                        self.step()
+                    except Exception as e:  # noqa: BLE001 - step() has
+                        # already failed the affected requests; the loop
+                        # must survive to serve the next ones (a dead
+                        # driver thread would hang every future submit)
+                        import traceback
+
+                        print(f"inference-engine step error: {e}",
+                              file=sys.stderr)
+                        traceback.print_exc()
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="inference-engine")
+        self._thread.start()
+
+    def stop(self) -> None:
+        """Stop the step-loop thread and fail whatever it leaves behind:
+        waiters on in-flight or still-queued requests block on done.wait()
+        with no timeout, so every abandoned request must be signalled or
+        its thread hangs forever."""
+        if self._thread is None:
+            return
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        self._thread.join(timeout=30)
+        if self._thread.is_alive():
+            # a stalled device step still owns the slot state — tearing
+            # it down now would race the zombie and let a later start()
+            # spawn a second concurrent step loop
+            raise RuntimeError(
+                "inference-engine step loop did not stop within 30s")
+        self._thread = None
+        with self._cv:
+            leftovers = list(self._queue)
+            self._queue.clear()
+        for i in range(self.num_slots):
+            req = self.slots[i]
+            if req is not None:
+                self._clear_slot(i)
+                req._finish("engine stopped")
+        for req in leftovers:
+            req._finish("engine stopped")
+        self._carry = None

@@ -53,3 +53,67 @@ def sample_logits(
                            jnp.finfo(jnp.float32).min, logits)
 
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def sample_logits_batched(
+    logits: jnp.ndarray,          # [B, V] float
+    keys: jnp.ndarray,            # [B, 2] per-row PRNG keys
+    temperature: jnp.ndarray,     # [B] float; 0 = greedy for that row
+    top_k: jnp.ndarray,           # [B] int; 0 disables
+    top_p: jnp.ndarray,           # [B] float; 0 disables
+    vocab_size: Optional[int] = None,
+) -> jnp.ndarray:
+    """Per-row sampling for the continuous-batching engine: every knob is
+    a traced [B] array so heterogeneous requests (different temperatures,
+    top-k/top-p) share ONE compiled decode step — the scalar sampler's
+    static args would force a recompile per sampling config. Row semantics
+    match sample_logits exactly: greedy rows ignore the filters, top-k and
+    top-p compose on sorted logits, padded vocab columns are clamped.
+
+    The expensive pieces run under lax.cond on what the batch actually
+    needs: all-greedy traffic pays one argmax (no sort, no categorical),
+    and the [B, V] filter sort only runs when some row has top-k/top-p.
+    XLA:CPU's sort is scalar — unconditionally sorting every tick was
+    ~3x the whole decode step (bench.py serving numbers)."""
+    logits = logits.astype(jnp.float32)
+    neg = jnp.finfo(jnp.float32).min
+    V = logits.shape[-1]
+    if vocab_size is not None and vocab_size < V:
+        logits = jnp.where(jnp.arange(V) < vocab_size, logits, neg)
+
+    # greedy rows bypass temperature/filters entirely (scalar fast path)
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def _sample(logits):
+        t = temperature[:, None]
+        scaled = logits / jnp.where(t > 0, t, 1.0)
+
+        def _filter(scaled):
+            # top-k: kth-largest per row as threshold (rows with
+            # top_k<=0 keep all)
+            desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+            kth = jnp.take_along_axis(
+                desc, jnp.clip(top_k[:, None] - 1, 0, V - 1), axis=-1)
+            cond_tk = (top_k[:, None] > 0) & (scaled < kth)
+            scaled = jnp.where(cond_tk, neg, scaled)
+            # top-p over the top-k-filtered logits (same composition
+            # order as the scalar sampler); always keeps each row's top
+            # token. Masking only values BELOW kth turns a descending
+            # sort into neg-padded descending, so no re-sort is needed.
+            desc = jnp.where((top_k[:, None] > 0) & (desc < kth), neg,
+                             desc)
+            cum = jnp.cumsum(jax.nn.softmax(desc, axis=-1), axis=-1)
+            cutoff_idx = jnp.sum(cum < top_p[:, None], axis=-1,
+                                 keepdims=True)
+            cutoff = jnp.take_along_axis(desc, cutoff_idx, axis=-1)
+            return jnp.where((top_p[:, None] > 0) & (scaled < cutoff),
+                             neg, scaled)
+
+        scaled = jax.lax.cond(jnp.any((top_k > 0) | (top_p > 0)),
+                              _filter, lambda s: s, scaled)
+        return jax.vmap(jax.random.categorical)(keys, scaled).astype(
+            jnp.int32)
+
+    sampled = jax.lax.cond(jnp.any(temperature > 0), _sample,
+                           lambda _: greedy_tok, logits)
+    return jnp.where(temperature > 0, sampled, greedy_tok)

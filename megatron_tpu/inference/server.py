@@ -9,8 +9,17 @@ same request schema:
    "random_seed": S, "beam_width": W?}
 
 beam_width switches to beam search (the reference's separate BEAM choice
-int broadcast becomes just a field — no multi-rank choreography). A global
-lock serializes requests like the reference's Flask lock.
+int broadcast becomes just a field — no multi-rank choreography).
+
+Two execution models behind the same schema:
+
+  * engine_slots > 0 (default for the CLI): sampling requests go through
+    the continuous-batching InferenceEngine — concurrent HTTP handlers
+    each submit their prompts and SHARE every batched decode tick instead
+    of serializing behind a lock (docs/serving.md). Beam search and
+    scoring (tokens_to_generate == 0) still take the one-shot path.
+  * engine_slots == 0: the reference's Flask-era shape — a global lock
+    serializes whole requests through generate_tokens.
 """
 
 from __future__ import annotations
@@ -34,10 +43,15 @@ MAX_PROMPTS = 128
 
 class GenerationService:
     def __init__(self, cfg: ModelConfig, params: Any, tokenizer,
-                 mesh=None, forward_fn=None, kv_cache_int8=False):
+                 mesh=None, forward_fn=None, kv_cache_int8=False,
+                 engine_slots: int = 0, engine_max_seq_len=None):
         """mesh + forward_fn serve sharded models: the mesh becomes
         ambient around generation (GSPMD handles tp/cp), forward_fn is the
-        pp>1 pipelined forward (ref ForwardStep, forward_step.py:45-204)."""
+        pp>1 pipelined forward (ref ForwardStep, forward_step.py:45-204).
+
+        engine_slots > 0 builds a continuous-batching InferenceEngine with
+        that many KV-cache slots plus its background step-loop thread;
+        concurrent sampling requests then share each decode tick."""
         if kv_cache_int8 and forward_fn is not None:
             # fail at construction, not as a 500 on every request — the
             # pipelined forward threads bf16 cache pairs (the same guard
@@ -45,6 +59,10 @@ class GenerationService:
             raise ValueError(
                 "kv_cache_int8 is not supported with a pipelined (pp>1) "
                 "forward_fn — serve pp>1 models with bf16 KV caches")
+        if engine_slots and forward_fn is not None:
+            raise ValueError(
+                "the continuous-batching engine runs the single-stage "
+                "forward only — serve pp>1 models with engine_slots=0")
         self.cfg = cfg
         self.params = params
         self.tokenizer = tokenizer
@@ -52,6 +70,21 @@ class GenerationService:
         self.forward_fn = forward_fn
         self.kv_cache_int8 = kv_cache_int8
         self.lock = threading.Lock()
+        self.engine = None
+        if engine_slots:
+            from megatron_tpu.inference.engine import InferenceEngine
+
+            self.engine = InferenceEngine(
+                cfg, params, num_slots=engine_slots,
+                max_seq_len=engine_max_seq_len,
+                kv_cache_int8=kv_cache_int8,
+                vocab_size=tokenizer.vocab_size, mesh=mesh)
+            self.engine.start()
+
+    def shutdown(self) -> None:
+        """Stop the engine's step-loop thread (no-op without an engine)."""
+        if self.engine is not None:
+            self.engine.stop()
 
     def _mesh_scope(self):
         return (jax.sharding.set_mesh(self.mesh) if self.mesh is not None
@@ -69,8 +102,8 @@ class GenerationService:
         if not 0 <= n <= MAX_TOKENS_TO_GENERATE:
             raise ValueError(f"tokens_to_generate in [0, {MAX_TOKENS_TO_GENERATE}]")
 
-        with self.lock, self._mesh_scope():
-            if req.get("beam_width"):
+        if req.get("beam_width"):
+            with self.lock, self._mesh_scope():
                 if self.forward_fn is not None:
                     raise ValueError(
                         "beam search is not supported on pipelined (pp>1) "
@@ -84,6 +117,15 @@ class GenerationService:
                     kv_cache_int8=self.kv_cache_int8)
                 return {"text": texts, "segments": segments,
                         "scores": [float(s) for s in scores]}
+
+        # continuous batching: no request lock — the engine's slot
+        # scheduler interleaves every caller's prompts into shared decode
+        # ticks (scoring still needs the one-shot teacher-forced pass);
+        # the one-shot path serializes whole requests and makes the mesh
+        # ambient here (the engine's driver thread scopes its own)
+        use_engine = self.engine is not None and n > 0
+
+        def generate():
             texts, segments, logprobs, _ = generate_and_post_process(
                 self.cfg, self.params, self.tokenizer, prompts,
                 tokens_to_generate=n,
@@ -94,11 +136,17 @@ class GenerationService:
                 return_output_log_probs=bool(req.get("logprobs", False)),
                 random_seed=int(req.get("random_seed", 0)),
                 forward_fn=self.forward_fn,
-                kv_cache_int8=self.kv_cache_int8)
+                kv_cache_int8=self.kv_cache_int8,
+                engine=self.engine if use_engine else None)
             out = {"text": texts, "segments": segments}
             if logprobs is not None:
                 out["logprobs"] = [list(map(float, row)) for row in logprobs]
             return out
+
+        if use_engine:
+            return generate()
+        with self.lock, self._mesh_scope():
+            return generate()
 
 
 def make_handler(service: GenerationService):
@@ -132,10 +180,18 @@ def make_handler(service: GenerationService):
 
 def run_server(cfg: ModelConfig, params: Any, tokenizer,
                host: str = "0.0.0.0", port: int = 5000,
-               mesh=None, forward_fn=None, kv_cache_int8=False) -> None:
+               mesh=None, forward_fn=None, kv_cache_int8=False,
+               engine_slots: int = 0, engine_max_seq_len=None) -> None:
     service = GenerationService(cfg, params, tokenizer, mesh=mesh,
                                 forward_fn=forward_fn,
-                                kv_cache_int8=kv_cache_int8)
+                                kv_cache_int8=kv_cache_int8,
+                                engine_slots=engine_slots,
+                                engine_max_seq_len=engine_max_seq_len)
     server = ThreadingHTTPServer((host, port), make_handler(service))
-    print(f"serving generation API on http://{host}:{port}/api")
-    server.serve_forever()
+    mode = (f"continuous batching, {engine_slots} slots" if service.engine
+            else "one-shot")
+    print(f"serving generation API on http://{host}:{port}/api ({mode})")
+    try:
+        server.serve_forever()
+    finally:
+        service.shutdown()

@@ -206,8 +206,14 @@ def lm_forward(
     """
     if positions is None and kv_caches is not None:
         # incremental decode: q tokens sit at absolute positions
-        # cache_index .. cache_index+s-1 (for RoPE and absolute pos-emb)
-        positions = cache_index + jnp.arange(tokens.shape[1])[None, :]
+        # cache_index .. cache_index+s-1 (for RoPE and absolute pos-emb).
+        # A vector cache_index (continuous-batching slot cache: every row
+        # decodes at its OWN depth) broadcasts per row instead.
+        if getattr(cache_index, "ndim", 0) == 1:
+            positions = (jnp.asarray(cache_index)[:, None]
+                         + jnp.arange(tokens.shape[1])[None, :])
+        else:
+            positions = cache_index + jnp.arange(tokens.shape[1])[None, :]
 
     train = dropout_key is not None and (cfg.hidden_dropout > 0 or cfg.attention_dropout > 0)
     x = embed_tokens(

@@ -95,7 +95,18 @@ def attention_block(
     cp_prefill = (type(cache_index) is int and cache_index == 0 and s > 1
                   and cfg.attention_impl in ("ring", "ulysses"))
 
+    # A vector cache_index is the continuous-batching slot cache
+    # (inference/engine.py): every row decodes at its OWN depth, so each
+    # row's new K/V scatters to its own position and attention masks each
+    # row to its own valid prefix (kv_lengths). Single-token only —
+    # admission prefill enters slots one at a time at a static index.
+    per_slot = getattr(cache_index, "ndim", 0) == 1
+    if per_slot and s != 1:
+        raise ValueError(
+            f"per-slot cache_index requires single-token decode (s={s})")
+
     q_offset = 0
+    kv_lengths = None
     if kv_cache is not None and len(kv_cache) == 4:
         # int8 KV cache (serving option): quantize the new K/V slice on
         # write, dequantize the whole cache for attention — cache bytes
@@ -105,15 +116,23 @@ def attention_block(
         kq, vq, ks, vs = kv_cache
         knew, ksnew = quantize_kv(k)
         vnew, vsnew = quantize_kv(v)
-        at = (0, cache_index, 0, 0)
-        kq = jax.lax.dynamic_update_slice(kq, knew, at)
-        vq = jax.lax.dynamic_update_slice(vq, vnew, at)
-        ks = jax.lax.dynamic_update_slice(ks, ksnew.astype(ks.dtype), at)
-        vs = jax.lax.dynamic_update_slice(vs, vsnew.astype(vs.dtype), at)
+        if per_slot:
+            rows = jnp.arange(b)
+            kq = kq.at[rows, cache_index].set(knew[:, 0])
+            vq = vq.at[rows, cache_index].set(vnew[:, 0])
+            ks = ks.at[rows, cache_index].set(ksnew[:, 0].astype(ks.dtype))
+            vs = vs.at[rows, cache_index].set(vsnew[:, 0].astype(vs.dtype))
+            kv_lengths = cache_index + 1
+        else:
+            at = (0, cache_index, 0, 0)
+            kq = jax.lax.dynamic_update_slice(kq, knew, at)
+            vq = jax.lax.dynamic_update_slice(vq, vnew, at)
+            ks = jax.lax.dynamic_update_slice(ks, ksnew.astype(ks.dtype), at)
+            vs = jax.lax.dynamic_update_slice(vs, vsnew.astype(vs.dtype), at)
+            q_offset = cache_index
         k = dequantize_kv(kq, ks, cfg.dtype)
         v = dequantize_kv(vq, vs, cfg.dtype)
         kv_cache = (kq, vq, ks, vs)
-        q_offset = cache_index
         cp_prefill = False  # int8 serving is single-chip scope (STATUS
         # #30); attending the fresh bf16 k/v here would silently diverge
         # from the dequantized-cache numerics the int8 tests pin down
@@ -121,12 +140,20 @@ def attention_block(
         # functional KV cache: fixed-size [B, max_seq, nkv, D] buffers,
         # in-place slice update at cache_index (donated under jit).
         kc, vc = kv_cache
-        kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype), (0, cache_index, 0, 0))
-        vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype), (0, cache_index, 0, 0))
-        kv_cache = (kc, vc)
-        if not cp_prefill:
+        if per_slot:
+            rows = jnp.arange(b)
+            kc = kc.at[rows, cache_index].set(k[:, 0].astype(kc.dtype))
+            vc = vc.at[rows, cache_index].set(v[:, 0].astype(vc.dtype))
+            kv_cache = (kc, vc)
             k, v = kc, vc
-            q_offset = cache_index
+            kv_lengths = cache_index + 1
+        else:
+            kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype), (0, cache_index, 0, 0))
+            vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype), (0, cache_index, 0, 0))
+            kv_cache = (kc, vc)
+            if not cp_prefill:
+                k, v = kc, vc
+                q_offset = cache_index
 
     if cfg.attn_mask_type == "padding" and padding_mask is None:
         raise ValueError(
@@ -143,6 +170,7 @@ def attention_block(
         q_offset=q_offset,
         impl=cfg.attention_impl,
         softmax_fp32=cfg.softmax_fp32,
+        kv_lengths=kv_lengths,
     )
     out = maybe_fp8_matmul(cfg, ctx.reshape(b, s, nq * D),
                            deq(p["wo"], ctx.dtype))

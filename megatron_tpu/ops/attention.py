@@ -63,11 +63,41 @@ def attention(
     q_offset=0,
     impl: str = "xla",
     softmax_fp32: bool = True,
+    kv_lengths: Optional[jnp.ndarray] = None,  # [B] valid-prefix lengths
 ) -> jnp.ndarray:
     """Scaled dot-product attention with GQA. Returns [B, Sq, Hq, D].
 
     q_offset: absolute position of q[0] (incremental decoding with KV cache).
+
+    kv_lengths: per-row valid KV prefix (continuous-batching decode, where
+    every slot of the cache holds a sequence of a different age). Requires
+    q_len == 1 — the single query is the newest position (kv_lengths - 1),
+    so causality is subsumed by the prefix mask and the sliding window
+    becomes k_pos >= kv_lengths - window. On TPU under impl="pallas" this
+    runs the fused flash-decode kernel (ops/pallas/flash_decode.py) which
+    skips cache blocks past each row's prefix; elsewhere a masked einsum
+    computes the same values.
     """
+    if kv_lengths is not None:
+        if q.shape[1] != 1:
+            raise ValueError(
+                f"kv_lengths requires single-token decode (q_len="
+                f"{q.shape[1]}); batched prefill uses causal masking")
+        if dropout > 0.0 or padding_mask is not None:
+            raise ValueError("kv_lengths is a serving-decode path: no "
+                             "dropout / padding masks")
+        if impl == "pallas" and jax.default_backend() != "cpu":
+            try:
+                from megatron_tpu.ops.pallas.flash_decode import flash_decode
+
+                return flash_decode(q, k, v, kv_lengths,
+                                    sliding_window=sliding_window)
+            except (ImportError, ValueError) as e:
+                warnings.warn(
+                    f"flash-decode kernel unavailable ({e}); falling back "
+                    "to the masked-einsum decode path", stacklevel=2)
+        # masked-einsum fallback (exact): flow into the dense path below
+        # with the per-row prefix mask applied in place of the causal bias
     if impl in ("ring", "ulysses"):
         # context-parallel exact attention; requires an ambient mesh with a
         # "context" axis (jax.sharding.set_mesh) and no dropout/padding
@@ -165,9 +195,20 @@ def attention(
     qg = qf.reshape(b, sq, hkv, groups, d)
     scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, kf)  # [B, Hkv, G, Sq, Skv]
 
-    bias = _mask_bias(sq, skv, mask_type, sliding_window, q_offset, scores.dtype)
-    if bias is not None:
-        scores = scores + bias
+    if kv_lengths is not None:
+        # per-row valid prefix (slot cache): the query is the newest
+        # position, so prefix + window masking replaces the causal bias
+        k_pos = jnp.arange(skv)[None, :]
+        allowed = k_pos < kv_lengths[:, None]
+        if sliding_window is not None:
+            allowed &= k_pos >= kv_lengths[:, None] - sliding_window
+        neg = jnp.asarray(jnp.finfo(scores.dtype).min, scores.dtype)
+        scores = jnp.where(allowed[:, None, None, None, :], scores, neg)
+    else:
+        bias = _mask_bias(sq, skv, mask_type, sliding_window, q_offset,
+                          scores.dtype)
+        if bias is not None:
+            scores = scores + bias
     if padding_mask is not None:
         neg = jnp.asarray(jnp.finfo(scores.dtype).min, scores.dtype)
         scores = jnp.where(padding_mask[:, None, None, None, :], scores, neg)

@@ -1,0 +1,8 @@
+"""jax API-rename shims shared by the Pallas kernels."""
+
+from jax.experimental.pallas import tpu as pltpu
+
+# jax renamed TPUCompilerParams -> CompilerParams; accept either so the
+# kernels run on both sides of the rename
+CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams")

@@ -1,7 +1,10 @@
-"""bench.py contract: the driver parses exactly one JSON line from stdout
-with metric/value/unit/vs_baseline. Run the full candidate search at a
-tiny geometry (headline geometry monkeypatched) so the selection logic,
-OOM handling shape, and output schema are exercised hermetically."""
+"""bench.py contract: every stdout line is parseable JSON with
+metric/value/unit/vs_baseline, and the headline llama_train_step_mfu line
+comes LAST (the driver parses the final line; full runs emit the
+serve_decode_throughput_toks_per_s line before it). Run the full candidate
+search at a tiny geometry (headline geometry monkeypatched) so the
+selection logic, OOM handling shape, and output schema are exercised
+hermetically."""
 
 import io
 import json
@@ -34,8 +37,14 @@ def test_bench_main_emits_one_json_line(monkeypatch):
     with redirect_stdout(buf):
         bench.main()
     lines = [l for l in buf.getvalue().splitlines() if l.strip()]
-    assert len(lines) == 1
-    out = json.loads(lines[0])
+    # full (non-quick) runs: serving metric line, then the headline LAST
+    assert len(lines) == 2
+    serve = json.loads(lines[0])
+    assert serve["metric"] == "serve_decode_throughput_toks_per_s"
+    assert set(serve) >= {"metric", "value", "unit", "vs_baseline"}
+    assert "error" not in serve and serve["value"] > 0
+    assert serve["detail"]["decode_recompiles_after_warmup"] == 0
+    out = json.loads(lines[-1])
     assert out["metric"] == "llama_train_step_mfu"
     assert set(out) >= {"metric", "value", "unit", "vs_baseline", "detail"}
     # tiny-on-CPU MFU rounds to ~0; the contract is shape, not magnitude
@@ -111,7 +120,7 @@ def test_bench_probe_retries_until_backend_up(monkeypatch):
     buf = io.StringIO()
     with redirect_stdout(buf):
         bench.main()
-    out = json.loads(buf.getvalue().strip())
+    out = json.loads(buf.getvalue().splitlines()[-1])
     assert "error" not in out and len(calls) == 3
     assert out["detail"]["micro_bs"] == 2
 

@@ -34,23 +34,30 @@ def _f8_dot_survives(hlo: str) -> bool:
     XLA inserted an upcast (emulated path). Operand names alone are
     checked — HLO's text printer does not repeat operand types inline —
     so this cannot false-positive on a coincidental f8 string elsewhere.
+
+    The `%` sigil is optional on both definition LHS and operands (newer
+    XLA text printers omit it); names are normalized before lookup
+    (ADVICE r5 low #3).
     """
     import re
 
     dtype_of = {}
-    for m in re.finditer(r"(%[\w.\-]+)\s*=\s*([a-z0-9]+)\[", hlo):
+    for m in re.finditer(r"%?([\w.\-]+)\s*=\s*([a-z0-9]+)\[", hlo):
         dtype_of[m.group(1)] = m.group(2)
 
+    def dt(name: str) -> str:
+        return dtype_of.get(name.lstrip("%"), "")
+
     upcast_from_f8 = False
-    for m in re.finditer(r"=\s*([a-z0-9]+)\[[^\]]*\]\{?[^=]*?convert\((%[\w.\-]+)\)",
+    for m in re.finditer(r"=\s*([a-z0-9]+)\[[^\]]*\]\{?[^=]*?convert\((%?[\w.\-]+)\)",
                          hlo):
         res_dt, operand = m.group(1), m.group(2)
-        if dtype_of.get(operand, "").startswith("f8") and not res_dt.startswith("f8"):
+        if dt(operand).startswith("f8") and not res_dt.startswith("f8"):
             upcast_from_f8 = True
 
     dot_has_f8 = False
-    for m in re.finditer(r"\bdot\(\s*(%[\w.\-]+)\s*,\s*(%[\w.\-]+)", hlo):
-        if any(dtype_of.get(op, "").startswith("f8") for op in m.groups()):
+    for m in re.finditer(r"\bdot\(\s*(%?[\w.\-]+)\s*,\s*(%?[\w.\-]+)", hlo):
+        if any(dt(op).startswith("f8") for op in m.groups()):
             dot_has_f8 = True
     return dot_has_f8 and not upcast_from_f8
 

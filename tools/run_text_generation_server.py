@@ -22,6 +22,19 @@ def extra_args(parser):
     g = parser.add_argument_group("server")
     g.add_argument("--host", default="0.0.0.0")
     g.add_argument("--port", type=int, default=5000)
+    g.add_argument("--serve_num_slots", type=int, default=8,
+                   help="KV-cache slots for the continuous-batching engine "
+                        "(concurrent requests share every decode step; "
+                        "docs/serving.md). 0 restores the one-request-at-"
+                        "a-time server")
+    g.add_argument("--serve_max_seq_len", type=int, default=None,
+                   help="per-slot KV-cache length for the engine (default "
+                        "min(seq_length, 2048) — the persistent cache "
+                        "costs slots x this x layers x kv_heads x "
+                        "head_dim, so an uncapped long-context model "
+                        "would OOM at startup where the old per-request "
+                        "server booted). Raise it to serve longer "
+                        "prompt+generation budgets")
     g.add_argument("--kv_cache_int8", action="store_true",
                    help="serve with an int8-quantized KV cache (half the "
                         "cache HBM -> 2x context/batch per chip)")
@@ -107,9 +120,27 @@ def main(argv=None):
         print(f"serving sharded: mesh={dict(rt.mesh.shape)}"
               + (" (pipelined forward)" if forward_fn else ""))
 
+    engine_slots = args.serve_num_slots
+    if forward_fn is not None and engine_slots:
+        print("pipelined (pp>1) serving runs one-shot; ignoring "
+              f"--serve_num_slots {engine_slots}")
+        engine_slots = 0
+    engine_max_seq_len = args.serve_max_seq_len
+    if engine_slots and engine_max_seq_len is None:
+        engine_max_seq_len = min(cfg.model.seq_length, 2048)
+    if engine_slots:
+        m = cfg.model
+        gib = (2 * m.num_layers * engine_slots * engine_max_seq_len
+               * m.n_kv_heads * m.head_dim
+               * (1 if args.kv_cache_int8 else 2)) / 2**30
+        print(f"persistent KV cache: {engine_slots} slots x "
+              f"{engine_max_seq_len} tokens = {gib:.2f} GiB"
+              + (" (int8)" if args.kv_cache_int8 else " (bf16)"))
     run_server(cfg.model, params, tokenizer, host=args.host, port=args.port,
                mesh=mesh, forward_fn=forward_fn,
-               kv_cache_int8=args.kv_cache_int8)
+               kv_cache_int8=args.kv_cache_int8,
+               engine_slots=engine_slots,
+               engine_max_seq_len=engine_max_seq_len)
 
 
 if __name__ == "__main__":
