@@ -188,6 +188,31 @@ def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
                    default=True,
                    help="accepted for parity; params are always initialized "
                         "lazily/jitted here, there is no slow eager init to skip")
+    g.add_argument("--no_async_save", action="store_false", dest="async_save",
+                   default=True,
+                   help="block the train loop on each checkpoint write "
+                        "instead of overlapping it with compute")
+    g.add_argument("--keep_latest_k", type=int, default=None,
+                   help="retention: prune all but the newest K committed "
+                        "checkpoints after each save (default: keep all)")
+
+    g = p.add_argument_group("fault tolerance")
+    g.add_argument("--divergence_patience", type=int, default=100,
+                   help="trip the divergence sentinel after this many "
+                        "CONSECUTIVE non-finite/skipped optimizer steps "
+                        "(0 disables; isolated fp16 loss-scale skips never "
+                        "accumulate)")
+    g.add_argument("--loss_spike_factor", type=float, default=0.0,
+                   help="trip when loss > factor * EMA(loss) for "
+                        "--loss_spike_patience consecutive steps "
+                        "(0 disables)")
+    g.add_argument("--loss_spike_patience", type=int, default=5)
+    g.add_argument("--rollback_on_divergence", action="store_true",
+                   help="on sentinel trip: reload the newest valid "
+                        "checkpoint and fast-forward the data past the "
+                        "poison window instead of aborting")
+    g.add_argument("--max_rollbacks", type=int, default=3,
+                   help="abort anyway after this many divergence rollbacks")
 
     g = p.add_argument_group("mixed precision")
     g.add_argument("--bf16", action="store_true")
@@ -543,6 +568,13 @@ def args_to_run_config(args) -> RunConfig:
         finetune=args.finetune,
         no_load_optim=args.no_load_optim,
         no_load_rng=args.no_load_rng,
+        async_save=getattr(args, "async_save", True),
+        keep_latest_k=getattr(args, "keep_latest_k", None),
+        divergence_patience=getattr(args, "divergence_patience", 100),
+        loss_spike_factor=getattr(args, "loss_spike_factor", 0.0),
+        loss_spike_patience=getattr(args, "loss_spike_patience", 5),
+        rollback_on_divergence=getattr(args, "rollback_on_divergence", False),
+        max_rollbacks=getattr(args, "max_rollbacks", 3),
         log_interval=args.log_interval,
         tensorboard_dir=args.tensorboard_dir,
         wandb_logger=args.wandb_logger,

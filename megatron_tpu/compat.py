@@ -1,0 +1,51 @@
+"""jax public-API compatibility shims.
+
+The code targets the current jax API surface; some hosting images bake in
+an older jax where a few names had not yet been promoted out of jax._src.
+Each shim re-exports the internal implementation under the public name
+ONLY when the public name is missing, so on a current jax this module is a
+no-op. Installed from megatron_tpu/__init__.py (every entry point and test
+imports the package first).
+"""
+
+from __future__ import annotations
+
+
+def install() -> None:
+    import jax
+
+    missing = [n for n in ("set_mesh", "get_abstract_mesh", "use_mesh")
+               if not hasattr(jax.sharding, n)]
+    if not missing:
+        return
+    try:
+        from jax._src import mesh as mesh_lib
+    except Exception:  # noqa: BLE001 - no internals to borrow; leave as-is
+        return
+
+    import contextlib
+
+    @contextlib.contextmanager
+    def set_mesh(mesh):
+        """Ambient-mesh context for pre-promotion jax: publish the mesh to
+        every accessor the code reads — get_abstract_mesh() (ops adapting
+        to the mesh), get_concrete_mesh() (checkpoint restore placement),
+        and the legacy thread_resources mesh (bare-PartitionSpec
+        with_sharding_constraint) — WITHOUT the internal set_mesh's
+        sharding_in_types flip, which on this jax switches tracing into
+        the experimental explicit-sharding mode and rejects ordinary
+        reshapes inside jit."""
+        with mesh_lib.set_abstract_mesh(mesh.abstract_mesh), \
+                mesh_lib.set_concrete_mesh(mesh), mesh:
+            yield
+
+    def get_abstract_mesh():
+        return mesh_lib.get_abstract_mesh()
+
+    impls = {"set_mesh": set_mesh, "use_mesh": set_mesh,
+             "get_abstract_mesh": get_abstract_mesh}
+    for name in missing:
+        setattr(jax.sharding, name, impls[name])
+
+
+install()

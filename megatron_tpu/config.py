@@ -453,6 +453,28 @@ class TrainingConfig:
     finetune: bool = False
     no_load_optim: bool = False
     no_load_rng: bool = False
+    # overlap checkpoint serialization/writes with training compute
+    # (training/checkpointing.py AsyncCheckpointSaver); --no_async_save
+    # falls back to blocking saves
+    async_save: bool = True
+    # retention: keep only the newest K committed checkpoints (staging dirs
+    # and whatever the tracker points at are never pruned); None = keep all
+    keep_latest_k: Optional[int] = None
+
+    # divergence sentinel (training/resilience.py): abort — or roll back,
+    # with rollback_on_divergence — after this many CONSECUTIVE
+    # non-finite/skipped optimizer steps; 0 disables
+    divergence_patience: int = 100
+    # trip when the loss exceeds factor * EMA for loss_spike_patience
+    # consecutive steps; 0.0 disables spike detection
+    loss_spike_factor: float = 0.0
+    loss_spike_patience: int = 5
+    # on sentinel trip: reload the newest valid checkpoint and fast-forward
+    # the data past the poison window instead of aborting
+    rollback_on_divergence: bool = False
+    # give up (DivergenceError) after this many rollbacks — a model that
+    # re-diverges every time is genuinely diverging, not unlucky
+    max_rollbacks: int = 3
 
     # logging
     log_interval: int = 100

@@ -1,4 +1,4 @@
-"""Checkpoint save/load: sharded, resharding-free.
+"""Checkpoint save/load: sharded, resharding-free, crash-safe.
 
 Equivalent of megatron/checkpointing.py (740 LoC) with the layout the
 reference uses (`<save>/iter_{it:07d}/` + `latest_checkpointed_iteration.txt`
@@ -17,6 +17,29 @@ tracker) but a fundamentally different content model:
     the argparse namespace inside the .pt, checkpointing.py:267-285) and is
     checked on load (check_checkpoint_args equivalent).
 
+Crash-safety model (beyond the reference, which renames nothing and
+tolerates a torn save only by luck):
+
+  * ATOMIC saves: each checkpoint is staged into `iter_XXXXXXX.tmp/`,
+    a `manifest.json` (relative path -> size + crc32 of every file) is
+    written LAST as the commit record, the staging dir is renamed into
+    place with os.replace, and only then is the tracker bumped (itself via
+    tmp + os.replace). A kill at any instruction leaves either a fully
+    committed checkpoint or an ignorable `.tmp` dir.
+  * VERIFIABLE: verify_checkpoint() checks the manifest (existence + size;
+    deep=True also checksums), list_valid_checkpoints() enumerates the
+    committed-and-intact ones.
+  * ASYNC saves: AsyncCheckpointSaver overlaps serialization + disk write
+    with training compute (orbax AsyncCheckpointer: the save call returns
+    once device arrays are copied to host; a finalizer thread commits the
+    manifest/rename/tracker), with a barrier before the next save and a
+    forced flush on exit/SIGTERM, plus keep_latest_k retention that prunes
+    only committed older checkpoints.
+  * AUTO-FALLBACK resume: when the tracker is garbage or the checkpoint it
+    points to fails verification, loading walks back to the newest valid
+    checkpoint with a loud warning instead of raising, and uncommitted
+    staging dirs are cleaned up.
+
 Flags mirror the reference: --finetune (weights only, iteration reset),
 --no_load_optim, --load at a specific iteration.
 """
@@ -26,28 +49,344 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-from typing import Any, Dict, Optional, Tuple
+import re
+import shutil
+import threading
+import warnings
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
-import numpy as np
 import orbax.checkpoint as ocp
 
+from megatron_tpu.training import resilience
 from megatron_tpu.training.optimizer import TrainState
 
 TRACKER = "latest_checkpointed_iteration.txt"
+MANIFEST = "manifest.json"
+STAGING_SUFFIX = ".tmp"
+DISPLACED_SUFFIX = ".old"
+_ITER_RE = re.compile(r"^iter_(\d{7})$")
+_STAGING_RE = re.compile(r"^iter_(\d{7})\.tmp$")
+_DISPLACED_RE = re.compile(r"^(iter_\d{7})\.old$")
 
 
 def checkpoint_dir(save: str, iteration: int) -> str:
     return os.path.join(os.path.abspath(save), f"iter_{iteration:07d}")
 
 
+def _staging_dir(save: str, iteration: int) -> str:
+    return checkpoint_dir(save, iteration) + STAGING_SUFFIX
+
+
 def read_tracker(load: str) -> Optional[int]:
+    """Latest committed iteration per the tracker file, or None.
+
+    A tracker truncated to emptiness or garbage by a crash is treated as
+    MISSING (with a warning naming the file) rather than raising — so
+    fallback resume can walk back to the newest valid checkpoint instead
+    of the whole run dying on `int('')`."""
     path = os.path.join(load, TRACKER)
     if not os.path.exists(path):
         return None
     with open(path) as f:
         content = f.read().strip()
-    return int(content)
+    try:
+        return int(content)
+    except ValueError:
+        warnings.warn(
+            f"checkpoint tracker {path} is unreadable (content "
+            f"{content[:50]!r}); treating it as missing so resume can fall "
+            "back to the newest valid checkpoint")
+        return None
+
+
+# -- manifest / verification -------------------------------------------------
+
+
+def _crc32_file(path: str, chunk: int = 1 << 20) -> str:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            buf = f.read(chunk)
+            if not buf:
+                break
+            crc = zlib.crc32(buf, crc)
+    return f"{crc & 0xFFFFFFFF:08x}"
+
+
+def compute_manifest(path: str, hashes: bool = True) -> Dict[str, Any]:
+    """{relpath: {size, crc32}} over every file under `path` except the
+    manifest itself (which cannot self-describe)."""
+    files: Dict[str, Any] = {}
+    for root, _, names in os.walk(path):
+        for name in sorted(names):
+            fp = os.path.join(root, name)
+            rel = os.path.relpath(fp, path)
+            if rel == MANIFEST:
+                continue
+            entry: Dict[str, Any] = {"size": os.path.getsize(fp)}
+            if hashes:
+                entry["crc32"] = _crc32_file(fp)
+            files[rel] = entry
+    return files
+
+
+def write_manifest(path: str, iteration: int) -> str:
+    """Write the commit record. This is the LAST file written into the
+    staging dir: its presence means every byte listed in it was already on
+    disk when it was created.
+
+    Cost note: the crc32 pass re-reads every byte just written. On the
+    async path this runs on the finalizer thread (overlapped with compute,
+    it only delays the commit point); with --no_async_save it is part of
+    the save stall. Resume-time verification uses only sizes — the hashes
+    exist for `checkpoint_util.py verify --deep` bitrot checks, and
+    verify_checkpoint tolerates their absence if this ever becomes
+    opt-out."""
+    man = {"format": 1, "iteration": int(iteration),
+           "files": compute_manifest(path)}
+    out = os.path.join(path, MANIFEST)
+    tmp = out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(man, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, out)
+    return out
+
+
+def verify_checkpoint(path: str, deep: bool = False) -> Tuple[bool, str]:
+    """(ok, detail) for one checkpoint dir.
+
+    Shallow (default): every manifest entry exists with the recorded size —
+    catches truncation, missing files, and uncommitted staging dirs, and is
+    cheap enough to run on every resume. deep=True additionally verifies
+    crc32 checksums (bitrot; used by `checkpoint_util.py verify`).
+
+    Pre-manifest checkpoints (written before this scheme) are accepted as
+    "legacy" when they at least have meta.json + state/, since refusing to
+    resume from them would be strictly worse than trusting them."""
+    if not os.path.isdir(path):
+        return False, "missing directory"
+    if path.rstrip("/").endswith(STAGING_SUFFIX):
+        return False, "uncommitted staging dir"
+    man_path = os.path.join(path, MANIFEST)
+    if not os.path.exists(man_path):
+        if (os.path.exists(os.path.join(path, "meta.json"))
+                and os.path.isdir(os.path.join(path, "state"))):
+            return True, "legacy checkpoint without manifest (unverified)"
+        return False, "no manifest.json and incomplete layout"
+    try:
+        with open(man_path) as f:
+            manifest = json.load(f)
+        files = manifest["files"]
+    except (ValueError, KeyError, OSError) as e:
+        return False, f"unreadable manifest: {type(e).__name__}: {e}"
+    for rel, info in files.items():
+        fp = os.path.join(path, rel)
+        if not os.path.exists(fp):
+            return False, f"missing file {rel}"
+        size = os.path.getsize(fp)
+        if size != info["size"]:
+            return False, (f"size mismatch for {rel}: manifest "
+                           f"{info['size']}, on disk {size}")
+        if deep and "crc32" in info:
+            crc = _crc32_file(fp)
+            if crc != info["crc32"]:
+                return False, (f"checksum mismatch for {rel}: manifest "
+                               f"{info['crc32']}, on disk {crc}")
+    return True, f"{len(files)} files ok" + (" (deep)" if deep else "")
+
+
+def committed_iterations(load: str) -> List[int]:
+    """Iterations with a committed (renamed-into-place) dir, sorted."""
+    if not os.path.isdir(load):
+        return []
+    out = []
+    for name in os.listdir(load):
+        m = _ITER_RE.match(name)
+        if m and os.path.isdir(os.path.join(load, name)):
+            out.append(int(m.group(1)))
+    return sorted(out)
+
+
+def list_valid_checkpoints(load: str, deep: bool = False) -> List[int]:
+    """Sorted iterations whose checkpoint passes verify_checkpoint."""
+    return [it for it in committed_iterations(load)
+            if verify_checkpoint(checkpoint_dir(load, it), deep=deep)[0]]
+
+
+def cleanup_staging(save: str, min_age_seconds: float = 0.0) -> List[str]:
+    """Remove uncommitted `iter_XXXXXXX.tmp` staging dirs (a crash during
+    save leaves one behind); returns the removed names.
+
+    min_age_seconds > 0 spares any staging dir with a file written within
+    that window — for EXTERNAL callers (`checkpoint_util.py prune`) that
+    may run concurrently with a live training run whose async save is
+    mid-write. The training process itself owns its save dir (one save in
+    flight, cleaned at init/resume when nothing is writing) and uses 0.
+
+    Also repairs the one crash window of a same-iteration re-save: a kill
+    between "old dir shoved aside" and "new dir published" (_finalize)
+    leaves `iter_XXXXXXX.old` with no `iter_XXXXXXX` — the committed old
+    checkpoint is renamed back into place."""
+    import time
+
+    removed = []
+    if not os.path.isdir(save):
+        return removed
+    for name in os.listdir(save):
+        m = _DISPLACED_RE.match(name)
+        if not m:
+            continue
+        original = os.path.join(save, m.group(1))
+        if os.path.isdir(original):
+            shutil.rmtree(os.path.join(save, name), ignore_errors=True)
+        else:
+            os.replace(os.path.join(save, name), original)
+    now = time.time()
+    for name in os.listdir(save):
+        if not _STAGING_RE.match(name):
+            continue
+        path = os.path.join(save, name)
+        if min_age_seconds > 0:
+            newest = max((os.path.getmtime(os.path.join(r, f))
+                          for r, _, fs in os.walk(path) for f in fs),
+                         default=os.path.getmtime(path))
+            if now - newest < min_age_seconds:
+                continue  # possibly a live writer's staging dir
+        shutil.rmtree(path, ignore_errors=True)
+        removed.append(name)
+    return removed
+
+
+def prune_checkpoints(save: str, keep_latest_k: int,
+                      dry_run: bool = False) -> List[int]:
+    """Delete all but the newest keep_latest_k COMMITTED checkpoints.
+
+    Only manifested (post-atomic-scheme) checkpoints are eligible: legacy
+    dirs without a manifest are never auto-deleted, nor is whatever the
+    tracker currently points at (even if it would age out — the tracker
+    must never dangle). Returns the pruned iterations."""
+    if not keep_latest_k or keep_latest_k < 1:
+        return []
+    committed = [it for it in committed_iterations(save)
+                 if os.path.exists(os.path.join(checkpoint_dir(save, it),
+                                                MANIFEST))]
+    keep = set(committed[-keep_latest_k:])
+    tracked = read_tracker(save)
+    if tracked is not None:
+        keep.add(tracked)
+    pruned = []
+    for it in committed:
+        if it not in keep:
+            if not dry_run:
+                shutil.rmtree(checkpoint_dir(save, it), ignore_errors=True)
+            pruned.append(it)
+    return pruned
+
+
+def resolve_load_iteration(load: str, iteration: Optional[int] = None,
+                           deep: bool = False) -> Tuple[int, Optional[str]]:
+    """Which iteration to load: (iteration, fallback_reason|None).
+
+    An explicitly requested iteration is trusted as-is (the caller pinned
+    it; failing hard on corruption is the right answer there). Otherwise
+    the tracker's target is verified, and on failure — or on a missing /
+    garbage tracker — resume falls back to the newest VALID checkpoint
+    with a loud warning instead of raising, cleaning up uncommitted
+    staging dirs along the way. Raises FileNotFoundError only when nothing
+    loadable exists at all."""
+    if iteration is not None:
+        return iteration, None
+    problems = []
+    it = read_tracker(load)
+    if it is not None:
+        ok, detail = verify_checkpoint(checkpoint_dir(load, it), deep=deep)
+        if ok:
+            return it, None
+        problems.append(f"tracker points at iteration {it} but it failed "
+                        f"verification ({detail})")
+    else:
+        problems.append("tracker missing or unreadable")
+    # tidy BEFORE listing: recovers a checkpoint displaced by a crashed
+    # same-iteration re-save (it may be the only valid one) and drops
+    # uncommitted staging dirs. No need to exclude the tracker's failed
+    # target here — list_valid re-verifies everything post-cleanup, so if
+    # it shows up it was just repaired and is the right pick.
+    stale = cleanup_staging(load)
+    if stale:
+        problems.append(f"removed uncommitted staging dirs: {stale}")
+    valid = list_valid_checkpoints(load, deep=deep)
+    if not valid:
+        if it is None and not committed_iterations(load):
+            raise FileNotFoundError(f"no checkpoint tracker in {load}")
+        raise FileNotFoundError(
+            f"no valid checkpoint in {load} ({'; '.join(problems)})")
+    reason = "; ".join(problems)
+    warnings.warn(
+        f"checkpoint resume falling back to iteration {valid[-1]} in "
+        f"{load}: {reason}")
+    return valid[-1], reason
+
+
+# -- save --------------------------------------------------------------------
+
+
+def _finalize(save: str, stage: str, iteration: int, consumed_samples: int,
+              config: Optional[Dict[str, Any]], keep_latest_k: Optional[int],
+              log=None) -> str:
+    """Commit a staged checkpoint: meta.json -> manifest (commit record) ->
+    os.replace into place -> tracker bump -> retention. Runs after the
+    orbax write has fully finished (sync caller or async finalizer thread).
+
+    On multi-process runs only process 0 commits; the others merely
+    participated in the collective orbax write."""
+    save = os.path.abspath(save)
+    final = checkpoint_dir(save, iteration)
+    if jax.process_count() > 1 and jax.process_index() != 0:
+        return final
+    meta = {
+        "iteration": int(iteration),
+        "consumed_train_samples": int(consumed_samples),
+        "checkpoint_version": "tpu-1.0",
+        "config": config or {},
+    }
+    with open(os.path.join(stage, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=1)
+    # fault injection: a kill here leaves a fully written but UNcommitted
+    # staging dir — the case atomic saves exist for
+    resilience.maybe_kill("kill_during_save", iteration)
+    resilience.maybe_sleep("slow_save")
+    write_manifest(stage, iteration)
+    displaced = None
+    if os.path.isdir(final):
+        # re-save of the same iteration (fallback resume past a corrupt
+        # newer checkpoint, --finetune into the same dir): never rmtree the
+        # committed dir before the new one is in place — a kill in between
+        # would destroy the only copy. Two-phase: shove the old dir aside
+        # (atomic rename), publish, then delete; a kill between the renames
+        # leaves `iter_XXXXXXX.old`, which cleanup_staging renames back.
+        displaced = final + DISPLACED_SUFFIX
+        shutil.rmtree(displaced, ignore_errors=True)
+        os.replace(final, displaced)
+    os.replace(stage, final)
+    if displaced is not None:
+        shutil.rmtree(displaced, ignore_errors=True)
+    tracker_tmp = os.path.join(save, TRACKER + ".tmp")
+    with open(tracker_tmp, "w") as f:
+        f.write(str(iteration))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tracker_tmp, os.path.join(save, TRACKER))
+    if keep_latest_k:
+        pruned = prune_checkpoints(save, keep_latest_k)
+        if pruned and log:
+            log(f"pruned checkpoints {pruned} (keep_latest_k={keep_latest_k})")
+    if log:
+        log(f"saved checkpoint to {final}")
+    return final
 
 
 def save_checkpoint(
@@ -57,26 +396,98 @@ def save_checkpoint(
     consumed_samples: int = 0,
     config: Optional[Dict[str, Any]] = None,
 ) -> str:
-    """Write state + metadata, then atomically bump the tracker
-    (ref: save_checkpoint, checkpointing.py:243-337)."""
-    path = checkpoint_dir(save, iteration)
-    os.makedirs(os.path.dirname(path), exist_ok=True)
+    """Synchronous atomic save: stage -> orbax write -> manifest commit ->
+    rename -> tracker bump (ref: save_checkpoint, checkpointing.py:243-337).
+    The train loop uses AsyncCheckpointSaver instead; this is the one-shot
+    path for tools and tests."""
+    stage = _staging_dir(save, iteration)
+    shutil.rmtree(stage, ignore_errors=True)
+    os.makedirs(os.path.dirname(stage), exist_ok=True)
     ckptr = ocp.StandardCheckpointer()
-    ckptr.save(os.path.join(path, "state"), state, force=True)
+    ckptr.save(os.path.join(stage, "state"), state, force=True)
     ckptr.wait_until_finished()
-    meta = {
-        "iteration": int(iteration),
-        "consumed_train_samples": int(consumed_samples),
-        "checkpoint_version": "tpu-1.0",
-        "config": config or {},
-    }
-    with open(os.path.join(path, "meta.json"), "w") as f:
-        json.dump(meta, f, indent=1)
-    tracker_tmp = os.path.join(os.path.abspath(save), TRACKER + ".tmp")
-    with open(tracker_tmp, "w") as f:
-        f.write(str(iteration))
-    os.replace(tracker_tmp, os.path.join(os.path.abspath(save), TRACKER))
-    return path
+    return _finalize(save, stage, iteration, consumed_samples, config,
+                     keep_latest_k=None)
+
+
+class AsyncCheckpointSaver:
+    """Owner of the train loop's checkpoint writes.
+
+    save() returns as soon as the device arrays are copied to host (orbax
+    AsyncCheckpointer) — serialization, disk write, manifest commit,
+    rename, tracker bump, and retention pruning all happen on a finalizer
+    thread while training continues. A second save() first barriers on the
+    previous one; wait()/close() is the forced flush the exit paths call.
+    Errors raised on the finalizer thread are re-raised at the next
+    wait()/save()/close() rather than lost."""
+
+    def __init__(self, save: str, keep_latest_k: Optional[int] = None,
+                 log=None, async_save: bool = True):
+        self.save_dir = os.path.abspath(save)
+        self.keep_latest_k = keep_latest_k
+        self.log = log or (lambda _m: None)
+        self.async_save = async_save
+        os.makedirs(self.save_dir, exist_ok=True)
+        stale = cleanup_staging(self.save_dir)
+        if stale:
+            self.log(f"removed uncommitted checkpoint staging dirs {stale} "
+                     "(previous run died mid-save)")
+        self._ckptr = ocp.StandardCheckpointer()  # async under the hood
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._last_path: Optional[str] = None
+
+    def save(self, state: TrainState, iteration: int,
+             consumed_samples: int = 0,
+             config: Optional[Dict[str, Any]] = None) -> None:
+        self.wait()  # barrier: at most one checkpoint in flight
+        stage = _staging_dir(self.save_dir, iteration)
+        shutil.rmtree(stage, ignore_errors=True)
+        # returns once device->host copies are done; the write continues on
+        # orbax's background thread (donation-safe: the train step may
+        # reuse these buffers immediately)
+        self._ckptr.save(os.path.join(stage, "state"), state, force=True)
+
+        def _finish():
+            try:
+                self._ckptr.wait_until_finished()
+                self._last_path = _finalize(
+                    self.save_dir, stage, iteration, consumed_samples,
+                    config, self.keep_latest_k, self.log)
+            except BaseException as e:  # noqa: BLE001 - re-raised at wait()
+                self._error = e
+
+        if self.async_save:
+            self._thread = threading.Thread(
+                target=_finish, name=f"ckpt-finalize-{iteration}",
+                daemon=True)
+            self._thread.start()
+        else:
+            _finish()
+            self._raise_pending()
+
+    def wait(self) -> Optional[str]:
+        """Block until the in-flight save (if any) is committed; re-raise
+        any finalizer error. Returns the last committed path."""
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join()
+        self._raise_pending()
+        return self._last_path
+
+    def close(self) -> Optional[str]:
+        """Forced flush for exit/SIGTERM paths."""
+        path = self.wait()
+        self._ckptr.close()
+        return path
+
+    def _raise_pending(self):
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+
+# -- load --------------------------------------------------------------------
 
 
 def _template_sharding(x):
@@ -113,8 +524,6 @@ def _ambient_mesh():
         # Fail soft but NOT silent: a jax upgrade breaking this probe would
         # otherwise quietly pin large template restores to one device and
         # reintroduce the OOM this path exists to avoid (ADVICE r4).
-        import warnings
-
         warnings.warn(
             "checkpointing: ambient-mesh probe via jax._src.mesh failed "
             f"({type(e).__name__}: {e}); template restores without "
@@ -133,6 +542,33 @@ def _abstract_like(state: TrainState, shardings=None) -> TrainState:
         state, shardings)
 
 
+def _restore_pre_field_checkpoint(path: str, abstract: TrainState,
+                                  state_template: TrainState) -> TrainState:
+    """Restore a checkpoint whose TrainState predates fields the current
+    dataclass has (e.g. nonfinite_streak, added with the divergence
+    sentinel): restore exactly the fields the checkpoint recorded, fill
+    the new ones from the fresh template. A checkpoint with fields we do
+    NOT know is a different (newer) format and still fails hard."""
+    saved_keys = set(
+        ocp.PyTreeCheckpointer().metadata(os.path.join(path, "state")).keys())
+    field_names = [f.name for f in dataclasses.fields(state_template)]
+    unknown = saved_keys - set(field_names)
+    if unknown:
+        raise ValueError(
+            f"checkpoint at {path} has unknown TrainState fields "
+            f"{sorted(unknown)} — written by a NEWER version?")
+    target = {k: getattr(abstract, k) for k in field_names
+              if k in saved_keys}
+    ckptr = ocp.StandardCheckpointer()
+    restored = ckptr.restore(os.path.join(path, "state"), target)
+    missing = [k for k in field_names if k not in saved_keys]
+    warnings.warn(
+        f"checkpoint at {path} predates TrainState fields {missing}; "
+        "filling them from the fresh template")
+    return type(state_template)(
+        **restored, **{k: getattr(state_template, k) for k in missing})
+
+
 def load_checkpoint(
     load: str,
     state_template: TrainState,
@@ -149,6 +585,11 @@ def load_checkpoint(
     arrays directly onto the mesh — loading at a different topology than
     the save is just different shardings here.
 
+    When iteration is None, the tracker's target is verified first and a
+    corrupt/torn newest checkpoint falls back to the newest valid one (see
+    resolve_load_iteration) — a crash mid-save can cost at most one save
+    interval, never the run.
+
     finetune: restore model weights only, reset iteration/optimizer
     (ref: --finetune, checkpointing.py:634-687).
 
@@ -159,9 +600,7 @@ def load_checkpoint(
     the check: adopting weights under a changed config (longer context via
     rope scaling, different head) is exactly what --finetune is for.
     """
-    it = iteration if iteration is not None else read_tracker(load)
-    if it is None:
-        raise FileNotFoundError(f"no checkpoint tracker in {load}")
+    it, _fallback = resolve_load_iteration(load, iteration)
     path = checkpoint_dir(load, it)
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)
@@ -173,33 +612,40 @@ def load_checkpoint(
     try:
         restored: TrainState = ckptr.restore(os.path.join(path, "state"), abstract)
     except ValueError as e:
-        if "tree structures do not match" not in str(e) or state_template.master is not None:
+        if "Dict key mismatch" in str(e):
+            # checkpoint written before TrainState grew a field (e.g.
+            # nonfinite_streak): restore the fields it HAS, fill the rest
+            # from the fresh template
+            restored = _restore_pre_field_checkpoint(path, abstract,
+                                                     state_template)
+        elif "tree structures do not match" not in str(e) or state_template.master is not None:
             raise
-        # the checkpoint was written by a mixed-precision run (fp32 master
-        # copies present) but this template has none (fp32 params, or an
-        # inference-only load) — restore with a synthesized master tree and
-        # drop it below
-        import jax.numpy as jnp
-
-        if shardings is not None:
-            fake_master = jax.tree.map(
-                lambda x, s: jax.ShapeDtypeStruct(x.shape, jnp.float32,
-                                                  sharding=s),
-                state_template.params, shardings.params)
         else:
-            fake_master = jax.tree.map(
-                lambda x: jax.ShapeDtypeStruct(
-                    x.shape, jnp.float32, sharding=_template_sharding(x)),
-                state_template.params)
-        abstract = dataclasses.replace(abstract, master=fake_master)
-        restored = ckptr.restore(os.path.join(path, "state"), abstract)
-        # prefer the fp32 masters as the source of truth for params
-        restored = dataclasses.replace(
-            restored,
-            params=jax.tree.map(
-                lambda m, p: m.astype(p.dtype), restored.master,
-                state_template.params),
-            master=None)
+            # the checkpoint was written by a mixed-precision run (fp32
+            # master copies present) but this template has none (fp32
+            # params, or an inference-only load) — restore with a
+            # synthesized master tree and drop it below
+            import jax.numpy as jnp
+
+            if shardings is not None:
+                fake_master = jax.tree.map(
+                    lambda x, s: jax.ShapeDtypeStruct(x.shape, jnp.float32,
+                                                      sharding=s),
+                    state_template.params, shardings.params)
+            else:
+                fake_master = jax.tree.map(
+                    lambda x: jax.ShapeDtypeStruct(
+                        x.shape, jnp.float32, sharding=_template_sharding(x)),
+                    state_template.params)
+            abstract = dataclasses.replace(abstract, master=fake_master)
+            restored = ckptr.restore(os.path.join(path, "state"), abstract)
+            # prefer the fp32 masters as the source of truth for params
+            restored = dataclasses.replace(
+                restored,
+                params=jax.tree.map(
+                    lambda m, p: m.astype(p.dtype), restored.master,
+                    state_template.params),
+                master=None)
 
     if finetune or no_load_optim:
         restored = dataclasses.replace(
@@ -208,6 +654,7 @@ def load_checkpoint(
             mu=state_template.mu,
             nu=state_template.nu,
             scaler=state_template.scaler,
+            nonfinite_streak=state_template.nonfinite_streak,
         )
         if finetune:
             restored = dataclasses.replace(restored, step=state_template.step)
@@ -224,10 +671,12 @@ def load_params_only(
     """Restore just the model params subtree (weights-only export/serving) —
     avoids materializing optimizer moments for a read-only load.
 
-    Prefers the fp32 master copies when the checkpoint has them."""
-    it = iteration if iteration is not None else read_tracker(load)
-    if it is None:
-        raise FileNotFoundError(f"no checkpoint tracker in {load}")
+    Prefers the fp32 master copies when the checkpoint has them. Whether
+    they exist is decided from the checkpoint's own metadata, NOT by
+    try/excepting the restore — a bare except here used to mask real
+    corruption of the master arrays as "no master tree, fall back to
+    params"; now any restore failure propagates."""
+    it, _fallback = resolve_load_iteration(load, iteration)
     path = os.path.join(checkpoint_dir(load, it), "state")
 
     import jax
@@ -244,26 +693,35 @@ def load_params_only(
             tree)
 
     ckptr = ocp.PyTreeCheckpointer()
+    # a fp32 run saves master=None, which orbax records as an EMPTY subtree
+    # under the same key — presence alone is not enough, it must have leaves
+    saved = ckptr.metadata(path)
+    use_master = bool(jax.tree.leaves(saved.get("master")))
+    key = "master" if use_master else "params"
+    target = {key: abstract(params_template,
+                            dtype=jnp.float32 if use_master else None,
+                            shards=shardings)}
+    # PyTreeRestore ignores ShapeDtypeStruct.sharding unless it is also
+    # threaded through restore_args — without it orbax falls back to
+    # sharding-from-file (slow, unsafe across topologies). transforms={}
+    # makes this a partial restore: only the requested subtree is read.
+    restored = ckptr.restore(
+        path, args=ocp.args.PyTreeRestore(
+            item=target,
+            restore_args=ocp.checkpoint_utils.construct_restore_args(target),
+            transforms={}))[key]
+    # the transforms API leaves a leaf ABSTRACT (unrestored) rather than
+    # erroring when the checkpoint lacks it — turn that silence back into
+    # the hard failure a corrupt/partial checkpoint deserves
+    from jax.tree_util import keystr, tree_flatten_with_path
 
-    def restore(target):
-        # PyTreeRestore ignores ShapeDtypeStruct.sharding unless it is
-        # also threaded through restore_args — without it orbax falls
-        # back to sharding-from-file (slow, unsafe across topologies)
-        return ckptr.restore(
-            path, args=ocp.args.PyTreeRestore(
-                item=target,
-                restore_args=ocp.checkpoint_utils.construct_restore_args(
-                    target),
-                partial_restore=True))
-
-    try:
-        # prefer the fp32 master copies when the checkpoint has them
-        target = {"master": abstract(params_template, dtype=jnp.float32,
-                                     shards=shardings)}
-        restored = restore(target)["master"]
-    except Exception:
-        target = {"params": abstract(params_template, shards=shardings)}
-        restored = restore(target)["params"]
+    missing = [keystr(p) for p, v in tree_flatten_with_path(restored)[0]
+               if isinstance(v, jax.ShapeDtypeStruct)]
+    if missing:
+        raise ValueError(
+            f"checkpoint at {path} has no data for {len(missing)} "
+            f"requested '{key}' arrays (first: {missing[:3]}) — corrupt or "
+            "structurally incompatible checkpoint")
     # stored dtype may differ from the serving dtype (e.g. bf16 checkpoint
     # served fp32, or master fp32 served bf16) — land on the template's
     return jax.tree.map(lambda r, p: r.astype(p.dtype),

@@ -50,6 +50,12 @@ class TrainState:
     nu: Any                      # Adam second moment, fp32
     step: jnp.ndarray            # i32 scalar, completed optimizer steps
     scaler: Optional[ScalerState]
+    # i32 scalar, CONSECUTIVE skipped (non-finite) updates ending at the
+    # current step; reset to 0 by any finite step. The divergence sentinel
+    # (training/resilience.py) reads it via metrics["skip_streak"] — a run
+    # that has gone permanently NaN shows a monotonically growing streak,
+    # while fp16 loss-scale backoff shows isolated blips.
+    nonfinite_streak: jnp.ndarray
 
 
 # Leaf-name test for "is a bias or a norm scale" in models/params.py's
@@ -108,6 +114,7 @@ def init_train_state(
     return TrainState(
         params=params, master=master, mu=f32(params), nu=f32(params),
         step=jnp.zeros((), jnp.int32), scaler=scaler,
+        nonfinite_streak=jnp.zeros((), jnp.int32),
     )
 
 
@@ -126,6 +133,7 @@ def train_state_specs(
         mu=opt_specs, nu=opt_specs,
         step=P(),
         scaler=None,  # replaced by caller if scaler in use
+        nonfinite_streak=P(),
     )
 
 
@@ -245,14 +253,18 @@ def make_optimizer_step(cfg: OptimizerConfig, train_iters: int):
         scaler = (_update_scaler(cfg, state.scaler, ~finite)
                   if state.scaler is not None else None)
 
+        streak = jnp.where(finite, 0, state.nonfinite_streak + 1
+                           ).astype(jnp.int32)
         new_state = TrainState(
             params=new_params, master=master_out, mu=new_mu, nu=new_nu,
             step=jnp.where(finite, step1, state.step), scaler=scaler,
+            nonfinite_streak=streak,
         )
         metrics = {
             "grad_norm": norm,
             "lr": lr,
             "skipped": (~finite).astype(jnp.float32),
+            "skip_streak": streak.astype(jnp.float32),
         }
         if cfg.log_num_zeros_in_grad:
             metrics["num_zeros"] = count_zeros(grads)
@@ -292,13 +304,17 @@ def make_optimizer_step(cfg: OptimizerConfig, train_iters: int):
                 lambda mref, pold: mref.astype(pold.dtype), new_master, state.params)
             scaler = (_update_scaler(cfg, state.scaler, ~finite)
                       if state.scaler is not None else None)
+            streak = jnp.where(finite, 0, state.nonfinite_streak + 1
+                               ).astype(jnp.int32)
             new_state = TrainState(
                 params=new_params,
                 master=new_master if state.master is not None else None,
                 mu=new_mu, nu=state.nu,
-                step=jnp.where(finite, state.step + 1, state.step), scaler=scaler)
+                step=jnp.where(finite, state.step + 1, state.step),
+                scaler=scaler, nonfinite_streak=streak)
             return new_state, {"grad_norm": norm, "lr": lr,
-                               "skipped": (~finite).astype(jnp.float32)}
+                               "skipped": (~finite).astype(jnp.float32),
+                               "skip_streak": streak.astype(jnp.float32)}
         return apply_sgd
 
     if cfg.optimizer != "adam":

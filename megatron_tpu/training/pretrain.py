@@ -17,6 +17,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import os
+import signal as signal_module
 import sys
 import time
 from typing import Any, Callable, Dict, Iterator, Optional, Tuple
@@ -35,7 +36,7 @@ from megatron_tpu.parallel.mesh import MeshRuntime, build_mesh
 from megatron_tpu.parallel.sharding import (
     activation_spec, batch_spec, constrain, shard_tree, tree_shardings,
 )
-from megatron_tpu.training import checkpointing
+from megatron_tpu.training import checkpointing, resilience
 from megatron_tpu.training.microbatches import MicroBatchCalculator
 from megatron_tpu.training.optimizer import (
     TrainState, init_train_state, train_state_specs,
@@ -180,6 +181,29 @@ class TrainLoop:
             self._load()
         self.state = self._permute_state(self.state, to_placed=True)
 
+        # fault tolerance: async checkpoint writer (created on first save)
+        # and divergence sentinel (training/resilience.py)
+        t = run_cfg.training
+        self._saver: Optional[checkpointing.AsyncCheckpointSaver] = None
+        self._sentinel = None
+        if t.divergence_patience or t.loss_spike_factor:
+            self._sentinel = resilience.DivergenceSentinel(
+                patience=t.divergence_patience,
+                spike_factor=t.loss_spike_factor,
+                spike_patience=t.loss_spike_patience)
+        self._rollbacks = 0
+        self._skip_data_until = 0  # fast-forward bound after a rollback
+        # consecutive healthy (finite, real) steps since the last rollback;
+        # once training has advanced well past the poison window the
+        # rollback budget is restored, so widely separated TRANSIENT
+        # divergences over a long run don't exhaust max_rollbacks — only a
+        # model that re-diverges shortly after every restore does (the
+        # documented intent of the knob). The margin guarantees net forward
+        # progress between restores.
+        self._healthy_steps = 0
+        self._rollback_reset_after = 20 * max(
+            t.divergence_patience, t.loss_spike_patience, 25)
+
         sp = run_cfg.parallel.sequence_parallel
 
         def sharder(x, role):
@@ -260,14 +284,78 @@ class TrainLoop:
         t = self.cfg.training
         if not t.save:
             return
+        # the save-checkpoint span measures the train-loop STALL: with
+        # async_save that is the barrier on the previous save + the
+        # device->host copy; the serialization/write/commit runs on the
+        # saver's finalizer thread while the next steps compute
         self.timers("save-checkpoint", 0).start()
         # checkpoints are always canonical layer order (topology-portable)
         state = self._permute_state(self.state, to_placed=False)
-        path = checkpointing.save_checkpoint(
-            t.save, state, self.iteration, self.consumed_samples,
-            config=self.cfg.to_dict())
+        if self._saver is None:
+            self._saver = checkpointing.AsyncCheckpointSaver(
+                t.save, keep_latest_k=t.keep_latest_k, log=self.log,
+                async_save=t.async_save)
+        self._saver.save(state, self.iteration, self.consumed_samples,
+                         config=self.cfg.to_dict())
         self.timers("save-checkpoint", 0).stop()
-        self.log(f"saved checkpoint to {path}")
+
+    def _flush_saves(self):
+        """Barrier on any in-flight checkpoint write — the forced flush on
+        every exit path (normal return, SIGTERM, exception)."""
+        if self._saver is not None:
+            self._saver.wait()
+
+    def _handle_divergence(self, reason: str) -> bool:
+        """Sentinel tripped: roll back to the newest valid checkpoint (with
+        --rollback_on_divergence, while rollbacks remain) or raise
+        DivergenceError with the full diagnostic. Returns True after a
+        rollback so the loop rebuilds its data iterator."""
+        t = self.cfg.training
+        diag = (f"divergence sentinel tripped at iteration "
+                f"{self.iteration}: {reason}")
+        if not t.rollback_on_divergence:
+            self.log(diag + " — aborting (use --rollback_on_divergence "
+                     "to auto-recover from the last good checkpoint)")
+            raise resilience.DivergenceError(diag)
+        if self._rollbacks >= t.max_rollbacks:
+            raise resilience.DivergenceError(
+                f"{diag} — giving up after {self._rollbacks} rollbacks "
+                f"(max_rollbacks={t.max_rollbacks}); the model re-diverges "
+                "after every restore")
+        # roll back to our own saves first; a resumed/finetune run that
+        # diverges before its first save still has the checkpoint it was
+        # launched from in t.load
+        sources = [s for s in dict.fromkeys((t.save, t.load)) if s]
+        if not sources:
+            raise resilience.DivergenceError(
+                diag + " — no --save/--load directory to roll back to")
+        self._flush_saves()  # never roll back onto a half-written save
+        trip_iter = self.iteration
+        state = None
+        errors = []
+        for src in sources:
+            try:
+                state, it, consumed = checkpointing.load_checkpoint(
+                    src, self._permute_state(self.state, to_placed=False),
+                    shardings=self.state_shardings, config=self.cfg.to_dict())
+                break
+            except FileNotFoundError as e:
+                errors.append(str(e))
+        if state is None:
+            raise resilience.DivergenceError(
+                f"{diag} — no valid checkpoint to roll back to "
+                f"({'; '.join(errors)})")
+        self.state = self._permute_state(state, to_placed=True)
+        self.iteration = it
+        self.consumed_samples = consumed
+        self._rollbacks += 1
+        self._skip_data_until = trip_iter
+        self._sentinel.reset()
+        self.log(f"{diag} — rolled back to checkpoint at iteration {it} "
+                 f"(rollback {self._rollbacks}/{t.max_rollbacks}); "
+                 f"fast-forwarding data through iteration {trip_iter} to "
+                 "skip the poison window")
+        return True
 
     # -- steps --------------------------------------------------------------
 
@@ -453,6 +541,15 @@ class TrainLoop:
     ) -> TrainState:
         """train_iter_factory(consumed_samples, global_batch) returns an
         iterator of global batches at that batch size (rampup-aware)."""
+        try:
+            return self._train_inner(train_iter_factory, valid_iter_factory)
+        finally:
+            # forced flush: every exit path (normal return, SIGTERM,
+            # exception) barriers on the in-flight async checkpoint write
+            # so a committed tracker is what the next resume finds
+            self._flush_saves()
+
+    def _train_inner(self, train_iter_factory, valid_iter_factory):
         t = self.cfg.training
         if t.eval_only:
             if valid_iter_factory is None:
@@ -495,19 +592,28 @@ class TrainLoop:
                         break
                 self.timers("batch-generator", 0).stop()
 
-                skipped_iter = (self.iteration + 1) in t.skip_iters
+                fast_forward = self.iteration < self._skip_data_until
+                skipped_iter = (fast_forward
+                                or (self.iteration + 1) in t.skip_iters)
                 # trace-window management must see skipped iterations too,
                 # or a skip at the boundary strands the trace open/closed
                 self._profile_window()
                 if skipped_iter:
-                    # fault injection: consume the data, skip the update
-                    # (ref --skip_iters, training.py:397-425); eval /
+                    # consume the data, skip the update — either --skip_iters
+                    # fault injection (ref training.py:397-425) or the
+                    # post-rollback fast-forward past a poison window; eval /
                     # SIGTERM / exit / save checks below still run
                     self.iteration += 1
                     self.consumed_samples += gbs
                     self.log(f"iteration {self.iteration}: update skipped "
-                             "(--skip_iters)")
+                             + ("(post-rollback fast-forward)"
+                                if fast_forward else "(--skip_iters)"))
                 else:
+                    resilience.maybe_kill("kill_at", self.iteration + 1)
+                    if resilience.fault_active("nan_loss", self.iteration + 1):
+                        batch = resilience.poison_batch(batch)
+                        self.log("fault injection: nan_loss poisoning "
+                                 f"iteration {self.iteration + 1}")
                     # forward + backward + optimizer are ONE fused jit
                     # region here (the reference's separate spans,
                     # training.py:500-525, would break that fusion);
@@ -516,6 +622,37 @@ class TrainLoop:
                     metrics = self.train_step(batch)
                     loss_host = float(metrics["loss"])  # host sync
                     self.timers("forward-backward-optimizer", 0).stop()
+
+                    if self._sentinel is not None:
+                        streak = metrics.get("skip_streak")
+                        step_skipped = bool(float(metrics.get("skipped", 0.0)))
+                        trip = self._sentinel.observe(
+                            loss_host, step_skipped,
+                            streak=(int(float(streak)) if streak is not None
+                                    else None))
+                        if trip is None and not step_skipped:
+                            self._healthy_steps += 1
+                            if (self._rollbacks
+                                    and self.iteration > self._skip_data_until
+                                    and self._healthy_steps
+                                    >= self._rollback_reset_after):
+                                self.log(
+                                    f"sentinel: {self._healthy_steps} healthy"
+                                    " steps since the last rollback —"
+                                    " restoring the rollback budget")
+                                self._rollbacks = 0
+                        else:
+                            self._healthy_steps = 0
+                        if trip and self._handle_divergence(trip):
+                            # rolled back: rebuild the data iterator at the
+                            # rewound consumed_samples and discard the
+                            # contaminated logging window
+                            data_iter = None
+                            current_gbs = None
+                            window_tokens, window_t0 = 0, time.time()
+                            loss_avg, loss_n = 0.0, 0
+                            self.timers.elapsed_ms(reset=True)
+                            continue
 
                     ntok = batch.get("tokens",
                                      next(iter(batch.values()))).size
@@ -605,8 +742,11 @@ class TrainLoop:
                     self.writer.flush()
 
                 should_exit = False
-                if sig.signals_received():
-                    self.log("received SIGTERM, checkpointing and exiting")
+                received = sig.signals_received()
+                if received:
+                    names = ",".join(
+                        signal_module.Signals(s).name for s in received)
+                    self.log(f"received {names}, checkpointing and exiting")
                     should_exit = True
                 if t.exit_interval and self.iteration % t.exit_interval == 0:
                     should_exit = True

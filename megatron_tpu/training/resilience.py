@@ -1,0 +1,198 @@
+"""Fault tolerance for the train loop: divergence sentinel + fault injection.
+
+Beyond the reference, which stops at dist_signal_handler (graceful SIGTERM)
+and DynamicGradScaler skip-on-overflow: at production scale a run that goes
+NaN keeps skipping steps forever, and the checkpoint/resume path is only
+trustworthy if it is routinely exercised against real crashes. This module
+provides
+
+  * DivergenceSentinel — host-side watchdog over the per-step metrics.
+    Trips on a streak of consecutive non-finite/skipped optimizer steps
+    (the signal the optimizer exposes as TrainState.nonfinite_streak /
+    metrics["skip_streak"]) or on a sustained loss spike against an EMA
+    baseline. The train loop either aborts with a diagnostic
+    (DivergenceError) or, with --rollback_on_divergence, reloads the last
+    good checkpoint and fast-forwards the data sampler past the poison
+    window (megatron_tpu/training/pretrain.py _handle_divergence).
+
+  * A fault-injection harness driven by the MEGATRON_TPU_FAULT env var, so
+    the kill/resume and rollback paths are exercised by real subprocess
+    tests rather than mocks. Comma-separated specs of int-arg'd faults:
+
+      kill_during_save:ITER   SIGKILL the process while finalizing the
+                              checkpoint for ITER (after the orbax write,
+                              before the manifest commit) — leaves an
+                              uncommitted staging dir behind
+      kill_at:ITER            SIGKILL right before running iteration ITER
+                              (a preemption that missed the SIGTERM grace)
+      nan_loss:ITER[:N]       poison the batch loss_mask for iterations
+                              [ITER, ITER+N) (default N=1) so the loss and
+                              grads go non-finite through the REAL skip
+                              path, not a mocked metric
+      slow_save:MS            sleep MS milliseconds inside checkpoint
+                              finalization — widens the async-save commit
+                              window for deterministic overlap tests
+
+The env var is re-parsed when its value changes, so tests can monkeypatch
+it without reimporting.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import signal
+import sys
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+FAULT_ENV = "MEGATRON_TPU_FAULT"
+
+_parse_cache: Tuple[Optional[str], Dict[str, Tuple[int, ...]]] = (None, {})
+
+
+class DivergenceError(RuntimeError):
+    """Training diverged and the sentinel decided recovery is impossible
+    (or was not requested). Carries the full diagnostic in str(e)."""
+
+
+def parse_fault_env(value: Optional[str] = None) -> Dict[str, Tuple[int, ...]]:
+    """'kill_during_save:4,nan_loss:3:2' -> {'kill_during_save': (4,),
+    'nan_loss': (3, 2)}. Malformed specs raise (a typo'd fault silently
+    not firing would invalidate the test run it was meant to drive)."""
+    raw = os.environ.get(FAULT_ENV, "") if value is None else value
+    global _parse_cache
+    if _parse_cache[0] == raw:
+        return _parse_cache[1]
+    out: Dict[str, Tuple[int, ...]] = {}
+    for spec in filter(None, (s.strip() for s in raw.split(","))):
+        kind, _, args = spec.partition(":")
+        try:
+            out[kind] = tuple(int(a) for a in args.split(":")) if args else ()
+        except ValueError:
+            raise ValueError(
+                f"{FAULT_ENV}: malformed fault spec {spec!r} "
+                "(form is kind:int[:int...])")
+    _parse_cache = (raw, out)
+    return out
+
+
+def fault_args(kind: str) -> Optional[Tuple[int, ...]]:
+    return parse_fault_env().get(kind)
+
+
+def fault_active(kind: str, iteration: int) -> bool:
+    """Whether `kind` fires at `iteration`. kill_* faults fire at exactly
+    their ITER; nan_loss fires over [ITER, ITER+N)."""
+    args = fault_args(kind)
+    if args is None or not args:
+        return False
+    if kind == "nan_loss":
+        count = args[1] if len(args) > 1 else 1
+        return args[0] <= iteration < args[0] + count
+    return iteration == args[0]
+
+
+def maybe_kill(kind: str, iteration: int) -> None:
+    """SIGKILL this process if the fault is armed for `iteration` — an
+    unmaskable death, like a preemption or OOM kill, so nothing downstream
+    (atexit, finally, signal handlers) can tidy up after it."""
+    if fault_active(kind, iteration):
+        sys.stderr.write(
+            f"MEGATRON_TPU_FAULT: {kind} firing at iteration {iteration} — "
+            "killing process\n")
+        sys.stderr.flush()
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def maybe_sleep(kind: str = "slow_save") -> None:
+    """Sleep args[0] milliseconds if the fault is armed (no iteration)."""
+    args = fault_args(kind)
+    if args:
+        import time
+
+        time.sleep(args[0] / 1000.0)
+
+
+def poison_batch(batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Inject a non-finite loss through the real numerics: an inf in the
+    loss_mask makes the masked-mean loss NaN, its grads non-finite, and the
+    optimizer skip the step (found-inf path) — exactly what a fp16 overflow
+    or corrupted batch produces, with no mocked metrics."""
+    out = dict(batch)
+    ref = out.get("loss_mask")
+    if ref is None:
+        ref = np.ones(np.asarray(out["tokens"]).shape, np.float32)
+    mask = np.array(ref, dtype=np.float32, copy=True)
+    mask.flat[0] = np.inf
+    out["loss_mask"] = mask
+    return out
+
+
+class DivergenceSentinel:
+    """Host-side divergence watchdog over per-step (loss, skipped) pairs.
+
+    Two independent detectors:
+      * non-finite streak: `patience` CONSECUTIVE steps that were skipped
+        by the optimizer or produced a non-finite loss. Isolated skips
+        (fp16 loss-scale backoff) reset the streak and never trip.
+      * loss spike: after `warmup_steps` finite losses establish an EMA
+        baseline, `spike_patience` consecutive losses above
+        `spike_factor * ema` trip. Spiking losses are NOT folded into the
+        EMA (a slow blow-up must not drag its own baseline up after it).
+
+    observe() returns None while healthy, or a human-readable trip reason.
+    Either detector is disabled by setting its knob to 0.
+    """
+
+    def __init__(self, patience: int = 100, spike_factor: float = 0.0,
+                 spike_patience: int = 5, ema_alpha: float = 0.05,
+                 warmup_steps: int = 20):
+        self.patience = int(patience)
+        self.spike_factor = float(spike_factor)
+        self.spike_patience = max(int(spike_patience), 1)
+        self.ema_alpha = float(ema_alpha)
+        self.warmup_steps = int(warmup_steps)
+        self.reset()
+
+    def reset(self) -> None:
+        """Fresh streaks and EMA — called after a rollback so the replayed
+        window is judged from scratch."""
+        self.nonfinite_streak = 0
+        self.spike_streak = 0
+        self.ema: Optional[float] = None
+        self.n_finite = 0
+
+    def observe(self, loss: Optional[float], skipped: bool = False,
+                streak: Optional[int] = None) -> Optional[str]:
+        """streak: the optimizer's device-tracked consecutive-skip count
+        (metrics["skip_streak"], persisted in TrainState.nonfinite_streak).
+        When given it OVERRIDES the host counter, so a run that resumes
+        mid-NaN — or crash-loops faster than `patience` steps — still
+        accumulates toward the trip instead of restarting from zero."""
+        bad = skipped or loss is None or not math.isfinite(loss)
+        if bad:
+            self.nonfinite_streak = (int(streak) if streak is not None
+                                     else self.nonfinite_streak + 1)
+            if self.patience and self.nonfinite_streak >= self.patience:
+                return (f"{self.nonfinite_streak} consecutive non-finite/"
+                        f"skipped optimizer steps (divergence_patience="
+                        f"{self.patience})")
+            return None
+        self.nonfinite_streak = 0
+        if (self.spike_factor > 0 and self.ema is not None
+                and self.n_finite >= self.warmup_steps
+                and loss > self.spike_factor * self.ema):
+            self.spike_streak += 1
+            if self.spike_streak >= self.spike_patience:
+                return (f"loss {loss:.6g} above loss_spike_factor="
+                        f"{self.spike_factor} x EMA {self.ema:.6g} for "
+                        f"{self.spike_streak} consecutive steps")
+            return None
+        self.spike_streak = 0
+        self.ema = (loss if self.ema is None
+                    else (1 - self.ema_alpha) * self.ema
+                    + self.ema_alpha * loss)
+        self.n_finite += 1
+        return None

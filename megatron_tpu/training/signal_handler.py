@@ -1,39 +1,69 @@
 """Graceful-exit signal handling.
 
-Equivalent of megatron/dist_signal_handler.py (81 LoC): install a SIGTERM
-handler that records the signal; the train loop polls it and
-checkpoints-then-exits. The reference all-gathers the flag over NCCL so
-every rank agrees; in a single-controller JAX program the controller *is*
-the agreement point, so the handler is just a flag.
+Equivalent of megatron/dist_signal_handler.py (81 LoC): install handlers
+that record the signal; the train loop polls and checkpoints-then-exits.
+The reference all-gathers the flag over NCCL so every rank agrees; in a
+single-controller JAX program the controller *is* the agreement point, so
+the handler is just a flag.
+
+Beyond the reference: multiple signals are handled (SIGTERM from the
+cluster scheduler AND SIGINT from a human, by default), the handler
+records *which* arrived, and a SECOND signal of any handled kind
+force-exits immediately via os._exit — so a checkpoint flush wedged on a
+dead filesystem can never block termination forever. The forced exit code
+is the conventional 128+signum.
 """
 
 from __future__ import annotations
 
+import os
 import signal
+import sys
 from types import FrameType
-from typing import Optional
+from typing import Optional, Sequence, Tuple
 
 
 class DistributedSignalHandler:
-    def __init__(self, sig: int = signal.SIGTERM):
-        self.sig = sig
-        self._received = False
-        self._prev = None
+    def __init__(self, sig: Optional[int] = None,
+                 signals: Optional[Sequence[int]] = None):
+        """signals: which to trap (default SIGTERM + SIGINT); the legacy
+        single-signal `sig` kwarg is kept for callers that trap one."""
+        if signals is None:
+            signals = (sig,) if sig is not None else (signal.SIGTERM,
+                                                      signal.SIGINT)
+        self.signals: Tuple[int, ...] = tuple(signals)
+        self.sig = self.signals[0]  # backward-compat attribute
+        self._received: list = []
+        self._prev: dict = {}
 
-    def signals_received(self) -> bool:
-        return self._received
+    def signals_received(self) -> Tuple[int, ...]:
+        """Signal numbers received so far, in arrival order (empty tuple —
+        falsy — when none)."""
+        return tuple(self._received)
 
     def __enter__(self) -> "DistributedSignalHandler":
-        self._received = False
+        self._received = []
 
         def handler(signum: int, frame: Optional[FrameType]):
-            self._received = True
+            if self._received:
+                # second signal: the graceful path (checkpoint flush) is
+                # presumed wedged — die NOW, unmaskably
+                sys.stderr.write(
+                    f"received {signal.Signals(signum).name} after "
+                    f"{signal.Signals(self._received[0]).name}; "
+                    "forcing exit without waiting for checkpoint flush\n")
+                sys.stderr.flush()
+                os._exit(128 + signum)
+            self._received.append(signum)
 
-        self._prev = signal.getsignal(self.sig)
-        signal.signal(self.sig, handler)
+        for s in self.signals:
+            self._prev[s] = signal.getsignal(s)
+            signal.signal(s, handler)
         return self
 
     def __exit__(self, *exc):
-        if self._prev is not None:
-            signal.signal(self.sig, self._prev)
+        for s, prev in self._prev.items():
+            if prev is not None:
+                signal.signal(s, prev)
+        self._prev = {}
         return False

@@ -233,6 +233,307 @@ def test_checkpoint_util_copy_and_cast(tmp_path):
     np.testing.assert_allclose(a, b, rtol=1e-2, atol=1e-2)  # bf16 round
 
 
+def _truncate_largest_state_file(ckpt_dir):
+    """Chop the biggest array file in half — a torn write."""
+    import glob
+
+    files = [p for p in glob.glob(os.path.join(ckpt_dir, "state", "**", "*"),
+                                  recursive=True) if os.path.isfile(p)]
+    big = max(files, key=os.path.getsize)
+    with open(big, "r+b") as f:
+        f.truncate(os.path.getsize(big) // 2)
+    return big
+
+
+def test_save_is_manifested_and_verifiable(tmp_path):
+    """Every save commits a manifest; verify_checkpoint passes shallow and
+    deep; a flipped byte fails only the deep check, a truncation both."""
+    _, state = _state()
+    save = str(tmp_path / "ckpt")
+    checkpointing.save_checkpoint(save, state, 5, 50)
+    path = checkpointing.checkpoint_dir(save, 5)
+    assert os.path.exists(os.path.join(path, checkpointing.MANIFEST))
+    assert checkpointing.verify_checkpoint(path)[0]
+    assert checkpointing.verify_checkpoint(path, deep=True)[0]
+    assert checkpointing.list_valid_checkpoints(save) == [5]
+
+    big = _truncate_largest_state_file(path)
+    ok, detail = checkpointing.verify_checkpoint(path)
+    assert not ok and "size mismatch" in detail
+
+    # same-size corruption: only the deep (crc32) check catches it
+    checkpointing.save_checkpoint(save, state, 5, 50)  # fresh re-save
+    import glob
+
+    files = [p for p in glob.glob(os.path.join(path, "state", "**", "*"),
+                                  recursive=True) if os.path.isfile(p)]
+    big = max(files, key=os.path.getsize)
+    size = os.path.getsize(big)
+    with open(big, "r+b") as f:
+        f.seek(size // 2)
+        f.write(bytes((b ^ 0xFF) for b in open(big, "rb").read()[size // 2:
+                                                                 size // 2 + 64]))
+    assert os.path.getsize(big) == size
+    ok, _ = checkpointing.verify_checkpoint(path)
+    assert ok  # shallow: sizes still match
+    ok, detail = checkpointing.verify_checkpoint(path, deep=True)
+    assert not ok and "checksum mismatch" in detail
+
+
+def test_corrupt_scenarios_resolve_to_newest_valid(tmp_path):
+    """The ISSUE's corrupt-checkpoint matrix: truncated array file, garbage
+    tracker, stale staging dir, missing meta.json — each resolves to the
+    newest VALID checkpoint via fallback resume instead of raising."""
+    import json
+    import warnings
+
+    cfg, state = _state()
+    _, template = _state(seed=99)
+    save = str(tmp_path / "a")
+    for it in (2, 4, 6):
+        checkpointing.save_checkpoint(save, state, it, it * 10)
+
+    # 1) truncated array file in the newest checkpoint
+    _truncate_largest_state_file(checkpointing.checkpoint_dir(save, 6))
+    with pytest.warns(UserWarning, match="falling back to iteration 4"):
+        _, it, consumed = checkpointing.load_checkpoint(save, template)
+    assert (it, consumed) == (4, 40)
+
+    # 2) garbage tracker on top of that (torn tracker write)
+    with open(os.path.join(save, checkpointing.TRACKER), "w") as f:
+        f.write("\x00garbage")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert checkpointing.read_tracker(save) is None
+        _, it, _ = checkpointing.load_checkpoint(save, template)
+    assert it == 4
+    assert any("tracker" in str(x.message) for x in w)
+
+    # 3) stale staging dir: never listed as valid, cleaned by fallback
+    stage = checkpointing.checkpoint_dir(save, 8) + checkpointing.STAGING_SUFFIX
+    os.makedirs(os.path.join(stage, "state"))
+    with open(os.path.join(stage, "state", "junk"), "w") as f:
+        f.write("x")
+    assert checkpointing.list_valid_checkpoints(save) == [2, 4]
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        _, it, _ = checkpointing.load_checkpoint(save, template)
+    assert it == 4
+    assert not os.path.exists(stage)
+
+    # 4) missing meta.json (manifest present -> detected)
+    save_b = str(tmp_path / "b")
+    for it in (2, 4):
+        checkpointing.save_checkpoint(save_b, state, it, it * 10)
+    os.remove(os.path.join(checkpointing.checkpoint_dir(save_b, 4),
+                           "meta.json"))
+    ok, detail = checkpointing.verify_checkpoint(
+        checkpointing.checkpoint_dir(save_b, 4))
+    assert not ok and "meta.json" in detail
+    with pytest.warns(UserWarning, match="falling back to iteration 2"):
+        _, it, consumed = checkpointing.load_checkpoint(save_b, template)
+    assert (it, consumed) == (2, 20)
+
+    # an explicitly pinned iteration still fails hard on corruption
+    with pytest.raises(Exception):
+        checkpointing.load_checkpoint(save_b, template, iteration=4)
+
+
+def test_async_saver_commits_prunes_and_flushes(tmp_path):
+    """AsyncCheckpointSaver: commits on wait/close, keep_latest_k prunes
+    only committed older checkpoints, init cleans stale staging dirs."""
+    _, state = _state()
+    save = str(tmp_path / "ckpt")
+    stale = checkpointing.checkpoint_dir(save, 9) + checkpointing.STAGING_SUFFIX
+    os.makedirs(stale)
+    logs = []
+    saver = checkpointing.AsyncCheckpointSaver(save, keep_latest_k=2,
+                                               log=logs.append)
+    assert not os.path.exists(stale)  # init cleanup
+    for it in (1, 2, 3):
+        saver.save(state, it, it * 10)
+    saver.close()
+    assert checkpointing.read_tracker(save) == 3
+    assert checkpointing.list_valid_checkpoints(save) == [2, 3]
+    assert any("pruned" in l for l in logs)
+    # everything still on disk verifies deep
+    for it in (2, 3):
+        assert checkpointing.verify_checkpoint(
+            checkpointing.checkpoint_dir(save, it), deep=True)[0]
+
+
+def test_async_save_overlaps_compute(tmp_path, monkeypatch):
+    """Acceptance: train-loop stall per save is measurably below the
+    synchronous baseline. A slow_save fault injects a 400 ms commit delay;
+    the async save() call must return well before it while the sync path
+    eats it in-line. (Real no-fault stalls are printed as bench evidence.)"""
+    import time
+
+    _, state = _state()
+
+    def stall(async_save, tag, env):
+        monkeypatch.setenv("MEGATRON_TPU_FAULT", env)
+        saver = checkpointing.AsyncCheckpointSaver(
+            str(tmp_path / tag), async_save=async_save)
+        t0 = time.monotonic()
+        saver.save(state, 1, 10)
+        dt = time.monotonic() - t0
+        saver.close()
+        return dt
+
+    async_stall = stall(True, "a", "slow_save:400")
+    sync_stall = stall(False, "s", "slow_save:400")
+    assert async_stall < sync_stall
+    assert sync_stall >= 0.4  # ate the injected commit delay in-line
+    assert async_stall < 0.4  # returned before the commit finished
+
+    monkeypatch.delenv("MEGATRON_TPU_FAULT")
+    real_async = stall(True, "ra", "")
+    real_sync = stall(False, "rs", "")
+    print(f"save stall: async {real_async*1e3:.1f} ms vs "
+          f"sync {real_sync*1e3:.1f} ms (no fault), "
+          f"{async_stall*1e3:.1f} vs {sync_stall*1e3:.1f} ms (400 ms commit delay)")
+    for tag in ("a", "s", "ra", "rs"):
+        assert checkpointing.list_valid_checkpoints(str(tmp_path / tag)) == [1]
+
+
+def test_load_params_only_corruption_not_masked(tmp_path):
+    """Real corruption of the fp32 master arrays must RAISE, not silently
+    fall back to params (the bare-except bug this PR removes)."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    cfg, state = _state()
+    # give the checkpoint a real master tree (bf16 params + fp32 master)
+    bf16 = jax.tree.map(lambda x: x.astype(jnp.bfloat16), state.params)
+    state = dataclasses.replace(
+        state, params=bf16,
+        master=jax.tree.map(lambda x: x.astype(jnp.float32), state.params))
+    save = str(tmp_path / "ckpt")
+    checkpointing.save_checkpoint(save, state, 7, 70)
+    # sanity: intact checkpoint restores via the master tree
+    p = checkpointing.load_params_only(save, bf16, iteration=7)
+    assert jax.tree.leaves(p)[0].dtype == jnp.bfloat16
+
+    _truncate_largest_state_file(checkpointing.checkpoint_dir(save, 7))
+    with pytest.raises(Exception):
+        checkpointing.load_params_only(save, bf16, iteration=7)
+
+
+def test_pre_field_checkpoint_still_loads(tmp_path):
+    """A checkpoint whose TrainState predates a newly added field (e.g.
+    nonfinite_streak) still restores — the missing field fills from the
+    template with a warning, everything else comes from the checkpoint."""
+    import json
+
+    import orbax.checkpoint as ocp
+
+    cfg, state = _state()
+    save = str(tmp_path / "old")
+    # simulate the pre-PR on-disk format: same layout, state tree WITHOUT
+    # the new field
+    old_tree = {"params": state.params, "master": None, "mu": state.mu,
+                "nu": state.nu, "step": state.step, "scaler": None}
+    path = checkpointing.checkpoint_dir(save, 5)
+    os.makedirs(save, exist_ok=True)
+    ck = ocp.StandardCheckpointer()
+    ck.save(os.path.join(path, "state"), old_tree)
+    ck.wait_until_finished()
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump({"iteration": 5, "consumed_train_samples": 50,
+                   "checkpoint_version": "tpu-1.0", "config": {}}, f)
+    with open(os.path.join(save, checkpointing.TRACKER), "w") as f:
+        f.write("5")
+
+    _, template = _state(seed=99)
+    with pytest.warns(UserWarning, match="predates TrainState fields"):
+        restored, it, consumed = checkpointing.load_checkpoint(save, template)
+    assert (it, consumed) == (5, 50)
+    assert int(restored.nonfinite_streak) == 0
+    for a, b in zip(jax.tree.leaves(restored.params),
+                    jax.tree.leaves(state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # a checkpoint with fields we do NOT know still fails hard
+    new_tree = dict(old_tree, from_the_future=state.step)
+    path2 = checkpointing.checkpoint_dir(save, 7)
+    ck.save(os.path.join(path2, "state"), new_tree)
+    ck.wait_until_finished()
+    with open(os.path.join(path2, "meta.json"), "w") as f:
+        json.dump({"iteration": 7, "consumed_train_samples": 70,
+                   "checkpoint_version": "tpu-1.0", "config": {}}, f)
+    with pytest.raises(ValueError, match="unknown TrainState fields"):
+        checkpointing.load_checkpoint(save, template, iteration=7)
+
+
+def test_resave_crash_window_recovers_displaced_checkpoint(tmp_path):
+    """A same-iteration re-save shoves the old committed dir aside before
+    publishing (never rmtree-first). If the process dies between the two
+    renames, the displaced `.old` dir is the ONLY copy — resume must
+    rename it back and load it."""
+    _, state = _state()
+    _, template = _state(seed=99)
+    save = str(tmp_path)
+    checkpointing.save_checkpoint(save, state, 4, 40)
+    final = checkpointing.checkpoint_dir(save, 4)
+    # simulate the kill between "old shoved aside" and "new published"
+    os.replace(final, final + checkpointing.DISPLACED_SUFFIX)
+    assert checkpointing.list_valid_checkpoints(save) == []
+    with pytest.warns(UserWarning, match="falling back to iteration 4"):
+        restored, it, consumed = checkpointing.load_checkpoint(save, template)
+    assert (it, consumed) == (4, 40)
+    assert os.path.isdir(final)
+    assert not os.path.exists(final + checkpointing.DISPLACED_SUFFIX)
+    # and a re-save over the recovered dir commits cleanly
+    checkpointing.save_checkpoint(save, state, 4, 44)
+    assert checkpointing.verify_checkpoint(final, deep=True)[0]
+
+
+def test_cleanup_staging_age_guard(tmp_path):
+    """checkpoint_util-style external pruning must not delete a staging
+    dir that a live run's async save is writing into."""
+    save = str(tmp_path)
+    stage = checkpointing.checkpoint_dir(save, 3) + checkpointing.STAGING_SUFFIX
+    os.makedirs(os.path.join(stage, "state"))
+    with open(os.path.join(stage, "state", "d"), "w") as f:
+        f.write("x")  # freshly written => a live writer
+    assert checkpointing.cleanup_staging(save, min_age_seconds=3600) == []
+    assert os.path.isdir(stage)
+    # the owner (age 0) still removes it
+    assert checkpointing.cleanup_staging(save) == ["iter_0000003.tmp"]
+    assert not os.path.exists(stage)
+
+
+def test_checkpoint_util_verify_and_prune(tmp_path, capsys):
+    """tools/checkpoint_util.py verify/prune subcommands on tiny real
+    checkpoints (ISSUE 2 satellite)."""
+    from tools import checkpoint_util
+
+    _, state = _state()
+    save = str(tmp_path / "run")
+    for it in (1, 2, 3):
+        checkpointing.save_checkpoint(save, state, it, it)
+
+    results = checkpoint_util.main(["verify", "--load", save, "--deep"])
+    assert [ok for _, ok in results] == [True, True, True]
+
+    _truncate_largest_state_file(checkpointing.checkpoint_dir(save, 2))
+    with pytest.raises(SystemExit):
+        checkpoint_util.main(["verify", "--load", save])
+    out = capsys.readouterr().out
+    assert "INVALID" in out and "size mismatch" in out
+
+    pruned = checkpoint_util.main(["prune", "--load", save,
+                                   "--keep_latest_k", "1", "--dry_run"])
+    assert pruned == [1, 2]
+    assert checkpointing.committed_iterations(save) == [1, 2, 3]
+    pruned = checkpoint_util.main(["prune", "--load", save,
+                                   "--keep_latest_k", "1"])
+    assert pruned == [1, 2]
+    assert checkpointing.committed_iterations(save) == [3]
+    assert checkpointing.read_tracker(save) == 3
+
+
 def test_restore_never_uses_sharding_from_file_fallback(tmp_path, recwarn):
     """Every restore path passes explicit target shardings (template leaf
     placement when the caller gives none) — orbax's sharding-from-file

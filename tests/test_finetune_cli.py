@@ -9,11 +9,15 @@ import sys
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@pytest.mark.slow
 def test_finetune_cli_instruction_data(tmp_path):
+    # ~35s: finetune.py subprocess with a cold jax start + fresh compile
+    # (deselectable with -m 'not slow', conftest marker doc)
     """preprocess_instruct_data -> finetune.py --data_type instruction:
     the reference's instruction-tuning recipe as a hermetic test."""
     rng = np.random.default_rng(0)

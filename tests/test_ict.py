@@ -7,6 +7,7 @@ import json
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from megatron_tpu.data.ict_dataset import ICTDataset
 from megatron_tpu.data.indexed_dataset import make_builder, make_dataset
@@ -125,7 +126,10 @@ def test_biencoder_learns_in_batch_retrieval():
     assert float(aux["top1_acc"]) > 100.0 / B
 
 
+@pytest.mark.slow
 def test_pretrain_ict_entry_runs(tmp_path):
+    # ~25s: pretrain_ict.py entry in-process with a fresh end-to-end
+    # compile (deselectable with -m 'not slow', conftest marker doc)
     import pretrain_ict
 
     blocks, titles = _block_corpus(tmp_path, n_docs=30)

@@ -43,7 +43,11 @@ def _run(cmd, **kw):
                           capture_output=True, text=True, timeout=600, **kw)
 
 
+@pytest.mark.slow
 def test_full_conversion_loop(tiny_hf_llama, tmp_path):
+    # ~130s: three subprocess tool invocations, each a cold jax start +
+    # fresh compile — multi-minute, so deselectable with -m 'not slow'
+    # like the other subprocess-compile monsters (conftest marker doc)
     native = str(tmp_path / "native")
     hf_out = str(tmp_path / "hf_roundtrip")
 

@@ -7,6 +7,7 @@ import json
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from megatron_tpu.models.biencoder import biencoder_config, biencoder_init_params
 from tasks.orqa_finetune import (
@@ -183,9 +184,11 @@ def test_orqa_eval_invariant_to_tail_padding():
                                    atol=1e-9)
 
 
+@pytest.mark.slow
 def test_orqa_harness_end_to_end(tmp_path):
     """tasks.main RET-FINETUNE-NQ on toy DPR data: runs, evals, learns
-    in-batch retrieval above chance."""
+    in-batch retrieval above chance. ~85s of finetune iterations —
+    multi-minute, deselectable with -m 'not slow' (conftest marker doc)."""
     from tasks import main as tasks_main
 
     train = tmp_path / "train.json"

@@ -4,6 +4,7 @@ megatron/data/t5_dataset.py + pretrain_t5.py, untested upstream)."""
 import json
 
 import numpy as np
+import pytest
 
 from megatron_tpu.data.indexed_dataset import make_builder, make_dataset
 from megatron_tpu.data.t5_dataset import T5Dataset, t5_span_corrupt
@@ -72,8 +73,10 @@ def test_t5_dataset_items(tmp_path):
     assert enc_sent == dec_sent >= 1
 
 
+@pytest.mark.slow
 def test_pretrain_t5_entry_runs(tmp_path):
-    """pretrain_t5.py end-to-end on a toy corpus: loss decreases."""
+    """pretrain_t5.py end-to-end on a toy corpus: loss decreases.
+    ~15s fresh enc-dec compile (deselectable with -m 'not slow')."""
     import pretrain_t5
     from tools import preprocess_data
 

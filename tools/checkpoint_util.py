@@ -1,16 +1,27 @@
 #!/usr/bin/env python
-"""Checkpoint copy / dtype-cast utility.
+"""Checkpoint copy / dtype-cast / verify / prune utility.
 
 The reference's tools/checkpoint_util.py + loader/saver plugins (907 LoC)
 exist to reshard checkpoints between tensor/pipeline layouts. Here that
 job is free — checkpoints are one logical orbax tree with sharding
 metadata and load at ANY topology (tests/test_checkpoint.py) — so this
-tool keeps only the remaining real uses: copying a checkpoint to a new
-directory, picking a specific iteration, and casting parameter dtype
-(e.g. fp32 masters -> bf16 serving weights).
+tool keeps the remaining real uses: copying a checkpoint to a new
+directory, picking a specific iteration, casting parameter dtype
+(e.g. fp32 masters -> bf16 serving weights), and the crash-safety
+subcommands built on the manifest API (docs/fault_tolerance.md):
 
+  # copy/cast (default mode, no subcommand)
   python tools/checkpoint_util.py --load ckpts/run --save ckpts/export \
       [--load_iters N] [--target_params_dtype bfloat16] [--params_only]
+
+  # verify manifests (existence+size; --deep adds crc32): exits non-zero
+  # if any checked checkpoint is invalid
+  python tools/checkpoint_util.py verify --load ckpts/run [--load_iters N] [--deep]
+
+  # retention: prune all but the newest K committed checkpoints, and
+  # uncommitted staging dirs left by crashes
+  python tools/checkpoint_util.py prune --load ckpts/run --keep_latest_k 3 \
+      [--dry_run]
 """
 
 import argparse
@@ -24,7 +35,69 @@ from megatron_tpu.platform import ensure_platform
 ensure_platform()
 
 
+def verify_main(argv=None):
+    """`verify` subcommand: manifest-check one or all checkpoints in a run
+    dir. Pure file I/O — never builds a model or touches devices."""
+    p = argparse.ArgumentParser(prog="checkpoint_util.py verify")
+    p.add_argument("--load", required=True)
+    p.add_argument("--load_iters", type=int, default=None,
+                   help="verify only this iteration (default: all found)")
+    p.add_argument("--deep", action="store_true",
+                   help="also verify crc32 checksums (reads every byte)")
+    args = p.parse_args(argv)
+
+    from megatron_tpu.training import checkpointing
+
+    iters = ([args.load_iters] if args.load_iters is not None
+             else checkpointing.committed_iterations(args.load))
+    if not iters:
+        raise SystemExit(f"no checkpoints found in {args.load}")
+    results = []
+    for it in iters:
+        ok, detail = checkpointing.verify_checkpoint(
+            checkpointing.checkpoint_dir(args.load, it), deep=args.deep)
+        results.append((it, ok))
+        print(f"iter {it:7d}: {'OK     ' if ok else 'INVALID'} {detail}")
+    tracked = checkpointing.read_tracker(args.load)
+    print(f"tracker: {tracked}; newest valid: "
+          f"{max((i for i, ok in results if ok), default=None)}")
+    if not all(ok for _, ok in results):
+        raise SystemExit(1)
+    return results
+
+
+def prune_main(argv=None):
+    """`prune` subcommand: keep_latest_k retention + stale staging
+    cleanup, driven by the same manifest API the train loop uses."""
+    p = argparse.ArgumentParser(prog="checkpoint_util.py prune")
+    p.add_argument("--load", required=True)
+    p.add_argument("--keep_latest_k", type=int, required=True)
+    p.add_argument("--dry_run", action="store_true")
+    p.add_argument("--staging_age_mins", type=float, default=60.0,
+                   help="only remove staging dirs idle this long — a LIVE "
+                        "training run's async save writes into a .tmp dir "
+                        "and must not be pruned from under it")
+    args = p.parse_args(argv)
+
+    from megatron_tpu.training import checkpointing
+
+    pruned = checkpointing.prune_checkpoints(
+        args.load, args.keep_latest_k, dry_run=args.dry_run)
+    stale = ([] if args.dry_run
+             else checkpointing.cleanup_staging(
+                 args.load, min_age_seconds=args.staging_age_mins * 60))
+    verb = "would prune" if args.dry_run else "pruned"
+    print(f"{verb} iterations {pruned}; removed staging dirs {stale}; "
+          f"kept {checkpointing.list_valid_checkpoints(args.load)}")
+    return pruned
+
+
 def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "verify":
+        return verify_main(argv[1:])
+    if argv and argv[0] == "prune":
+        return prune_main(argv[1:])
     p = argparse.ArgumentParser()
     p.add_argument("--load", required=True)
     p.add_argument("--save", required=True)
