@@ -336,6 +336,30 @@ def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
     g.add_argument("--profile_dir", default=None,
                    help="trace output dir (default: --tensorboard_dir)")
 
+    g = p.add_argument_group("telemetry")
+    g.add_argument("--telemetry_dir", default=None,
+                   help="write the structured event journal (per-step "
+                        "records, goodput ledger, checkpoint/rollback/"
+                        "fault events) as rotating JSONL under this dir "
+                        "(docs/observability.md; summarize with "
+                        "tools/telemetry_report.py)")
+    g.add_argument("--journal_max_mb", type=float, default=64.0,
+                   help="rotate the journal past this size (disk stays "
+                        "bounded on unbounded runs); 0 disables rotation")
+    g.add_argument("--metrics_port", type=int, default=None,
+                   help="sidecar Prometheus /metrics listener for the "
+                        "train loop (0 binds a free port; the serving "
+                        "server exposes /metrics on its own port)")
+    g.add_argument("--flight_recorder", action="store_true",
+                   help="arm the stall watchdog: no step heartbeat for "
+                        "--flight_recorder_deadline_s dumps all-thread "
+                        "stacks + the journal tail to a bundle dir")
+    g.add_argument("--flight_recorder_deadline_s", type=float, default=600.0)
+    g.add_argument("--flight_recorder_abort", action="store_true",
+                   help="after dumping the stall bundle, SIGABRT so the "
+                        "supervisor restarts the process with the "
+                        "evidence on disk")
+
     if extra_args_provider is not None:
         extra_args_provider(p)
     return p
@@ -587,6 +611,13 @@ def args_to_run_config(args) -> RunConfig:
         profile_step_start=getattr(args, "profile_step_start", 10),
         profile_step_end=getattr(args, "profile_step_end", 12),
         profile_dir=getattr(args, "profile_dir", None),
+        telemetry_dir=getattr(args, "telemetry_dir", None),
+        journal_max_mb=getattr(args, "journal_max_mb", 64.0),
+        metrics_port=getattr(args, "metrics_port", None),
+        flight_recorder=getattr(args, "flight_recorder", False),
+        flight_recorder_deadline_s=getattr(args, "flight_recorder_deadline_s",
+                                           600.0),
+        flight_recorder_abort=getattr(args, "flight_recorder_abort", False),
         eval_only=getattr(args, "eval_only", False),
         skip_iters=tuple(getattr(args, "skip_iters", []) or []),
         log_params_norm=getattr(args, "log_params_norm", False),

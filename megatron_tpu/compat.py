@@ -1,17 +1,95 @@
 """jax public-API compatibility shims.
 
 The code targets the current jax API surface; some hosting images bake in
-an older jax where a few names had not yet been promoted out of jax._src.
-Each shim re-exports the internal implementation under the public name
-ONLY when the public name is missing, so on a current jax this module is a
-no-op. Installed from megatron_tpu/__init__.py (every entry point and test
+an older jax where a few names had not yet been promoted out of jax._src
+(or jax.experimental — jax.shard_map). Each shim re-exports the
+internal/experimental implementation under the public name ONLY when the
+public name is missing, so on a current jax this module is a no-op.
+Installed from megatron_tpu/__init__.py (every entry point and test
 imports the package first).
 """
 
 from __future__ import annotations
 
+#: True when jax.shard_map had to be aliased from jax.experimental (i.e.
+#: this is the old toolchain whose XLA also carries the SPMD-partitioner
+#: quirks documented in _install_shard_map) — tests gate the few kernel
+#: paths that old XLA cannot compile on this flag, with precise reasons.
+SHARD_MAP_SHIMMED = False
+
 
 def install() -> None:
+    _install_mesh_accessors()
+    _install_shard_map()
+    _install_axis_size()
+
+
+def _install_axis_size() -> None:
+    """jax.lax.axis_size(name) (newer jax) from the bound axis env: inside
+    a shard_map/*map body the mapped axes' sizes are static trace-time
+    constants, which is exactly what the callers use it for."""
+    import jax
+
+    if hasattr(jax.lax, "axis_size"):
+        return
+
+    def axis_size(axis_name):
+        from jax._src import core as _core
+
+        sizes = _core.get_axis_env().axis_sizes
+        names = (axis_name if isinstance(axis_name, (tuple, list))
+                 else (axis_name,))
+        out = 1
+        for n in names:
+            if n not in sizes:
+                raise NameError(
+                    f"unbound axis name: {n} (bound: {sorted(sizes)})")
+            out *= sizes[n]
+        return out
+
+    jax.lax.axis_size = axis_size
+
+
+def _install_shard_map() -> None:
+    """Alias jax.shard_map (promoted in newer jax) onto
+    jax.experimental.shard_map with the new keyword surface.
+
+    Semantics note: the new API's `axis_names` marks which mesh axes are
+    MANUAL inside the body (the rest stay automatic/GSPMD). This jax's
+    partial-auto shard_map is not usable here: auto axes + ppermute
+    CHECK-crash the bundled XLA's SPMD partitioner (spmd_partitioner.cc),
+    and axis_index over a partial-manual mesh lowers to an unsupported
+    PartitionId. The shim therefore promotes ALL mesh axes to manual
+    (legacy auto=frozenset()), which is numerically equivalent — axes a
+    spec does not mention are replicated into every body instance — at
+    the cost of redundant per-device compute over the formerly-auto axes.
+    `check_vma` maps onto the legacy `check_rep`."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return
+    try:
+        from jax.experimental.shard_map import shard_map as _legacy
+    except Exception:  # noqa: BLE001 - nothing to borrow; leave as-is
+        return
+
+    import functools
+
+    @functools.wraps(_legacy)
+    def shard_map(f, *, mesh=None, in_specs, out_specs, axis_names=None,
+                  check_vma=True, **kw):
+        del axis_names  # full-manual only on this toolchain (see above)
+        if mesh is None:
+            mesh = jax.sharding.get_abstract_mesh()
+        return _legacy(f, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_rep=bool(check_vma), **kw)
+
+    jax.shard_map = shard_map
+    global SHARD_MAP_SHIMMED
+    SHARD_MAP_SHIMMED = True
+
+
+def _install_mesh_accessors() -> None:
     import jax
 
     missing = [n for n in ("set_mesh", "get_abstract_mesh", "use_mesh")
@@ -40,7 +118,11 @@ def install() -> None:
             yield
 
     def get_abstract_mesh():
-        return mesh_lib.get_abstract_mesh()
+        # this jax returns the raw context-stack value — an empty TUPLE —
+        # when no mesh is set; normalize to None so callers' `mesh is
+        # None or not mesh.shape` guards work unchanged
+        m = mesh_lib.get_abstract_mesh()
+        return m if hasattr(m, "shape") else None
 
     impls = {"set_mesh": set_mesh, "use_mesh": set_mesh,
              "get_abstract_mesh": get_abstract_mesh}

@@ -495,6 +495,27 @@ class TrainingConfig:
     profile_step_end: int = 12
     profile_dir: Optional[str] = None
 
+    # telemetry (megatron_tpu/telemetry; docs/observability.md):
+    # structured event journal (per-step records, goodput ledger,
+    # checkpoint/rollback/fault events) written as append-only JSONL under
+    # this dir; None disables
+    telemetry_dir: Optional[str] = None
+    # journal rotation threshold (segments beyond the live file + 2 are
+    # dropped, so disk stays bounded on unbounded runs); 0 disables
+    # rotation (one unbounded file, e.g. under an external log shipper)
+    journal_max_mb: float = 64.0
+    # sidecar Prometheus /metrics listener for the train loop (the serving
+    # server mounts /metrics on its own port); None disables, 0 binds a
+    # free port
+    metrics_port: Optional[int] = None
+    # flight recorder: watchdog armed by a per-step heartbeat that dumps
+    # all-thread stacks + the journal tail to a bundle when a step stalls
+    # past the deadline, then optionally SIGABRTs so the supervisor
+    # restarts the process with the evidence on disk
+    flight_recorder: bool = False
+    flight_recorder_deadline_s: float = 600.0
+    flight_recorder_abort: bool = False
+
     # run only the validation loop, then exit (ref --eval_only)
     eval_only: bool = False
 
@@ -540,6 +561,15 @@ class TrainingConfig:
                        else "non-negative layer count"))
         elif g not in RECOMPUTE_POLICIES:
             raise ValueError(f"bad recompute_granularity {g}")
+        if self.flight_recorder and self.flight_recorder_deadline_s <= 0:
+            raise ValueError(
+                f"flight_recorder_deadline_s="
+                f"{self.flight_recorder_deadline_s} must be > 0 (seconds "
+                "without a step heartbeat before the stall bundle dumps)")
+        if self.journal_max_mb < 0:
+            raise ValueError(
+                "journal_max_mb must be >= 0 (0 disables rotation: one "
+                "unbounded journal file)")
         if self.train_iters is None and self.train_samples is None:
             pass  # inference / tooling use
         return self

@@ -30,6 +30,7 @@ from __future__ import annotations
 import dataclasses
 import sys
 import threading
+import time
 from collections import deque
 from typing import Any, List, Optional
 
@@ -40,6 +41,7 @@ import numpy as np
 from megatron_tpu.config import ModelConfig
 from megatron_tpu.inference.generation import GenerationOutput, _init_caches
 from megatron_tpu.inference.sampling import sample_logits_batched
+from megatron_tpu.telemetry.metrics import MetricsRegistry, default_registry
 
 
 @dataclasses.dataclass
@@ -60,6 +62,9 @@ class Request:
     prompt_logprobs: List[float] = dataclasses.field(default_factory=list)
     done: threading.Event = dataclasses.field(default_factory=threading.Event)
     error: Optional[str] = None
+    # latency telemetry (monotonic clock): stamped by submit()/admission
+    submit_time: Optional[float] = None
+    first_token_time: Optional[float] = None
 
     @property
     def tokens(self) -> np.ndarray:
@@ -85,7 +90,9 @@ class InferenceEngine:
                  max_seq_len: Optional[int] = None,
                  kv_cache_int8: bool = False, prefill_bucket: int = 64,
                  vocab_size: Optional[int] = None, mesh=None,
-                 want_logprobs: bool = True):
+                 want_logprobs: bool = True,
+                 metrics: Optional[MetricsRegistry] = None,
+                 flight_recorder=None):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
         self.cfg = cfg
@@ -104,8 +111,8 @@ class InferenceEngine:
         self.want_logprobs = want_logprobs
 
         N = num_slots
-        self.caches = _init_caches(cfg, N, self.max_seq_len,
-                                   int8=kv_cache_int8)
+        self.caches = self._commit(
+            _init_caches(cfg, N, self.max_seq_len, int8=kv_cache_int8))
         self.slots: List[Optional[Request]] = [None] * N
         self.lengths = np.zeros(N, np.int32)    # valid context per slot
         self.last_tok = np.zeros(N, np.int32)   # sampled, not yet in cache
@@ -126,9 +133,50 @@ class InferenceEngine:
 
         self._decode_step = self._build_decode_step()
         self._prefill_steps = {}  # bucketed prompt length -> jitted fn
-        # observability for tests/metrics: monotonically-growing counters
+        # observability for tests/metrics: monotonically-growing counters.
+        # decode_recompiles counts decode-step compiles BEYOND the warmup
+        # one — the "zero recompiles after warmup" invariant (PR 1) as a
+        # runtime counter instead of a bench footnote
         self.stats = {"admitted": 0, "retired": 0, "ticks": 0,
-                      "rejected": 0}
+                      "rejected": 0, "decode_recompiles": 0}
+        self._decode_cache_seen = 0  # compiles observed on _decode_step
+
+        # Prometheus collectors (megatron_tpu/telemetry): shared with the
+        # serving HTTP layer via the process-default registry unless a
+        # test hands in its own. Flight recorder (optional): heartbeat
+        # per tick so a wedged device step dumps a stall bundle.
+        self.flight_recorder = flight_recorder
+        m = metrics if metrics is not None else default_registry()
+        self.metrics = m
+        self._m_slots = m.gauge("engine_slots_total", "KV-cache slots")
+        self._m_active = m.gauge("engine_slots_active",
+                                 "slots with a live request")
+        self._m_queue = m.gauge("engine_queue_depth",
+                                "requests waiting for a slot")
+        self._m_admitted = m.counter("engine_requests_admitted_total",
+                                     "requests admitted into a slot")
+        self._m_retired = m.counter("engine_requests_retired_total",
+                                    "requests completed")
+        self._m_rejected = m.counter("engine_requests_rejected_total",
+                                     "requests rejected (invalid/oversized/"
+                                     "failed prefill)")
+        self._m_ticks = m.counter("engine_ticks_total",
+                                  "batched decode steps executed")
+        self._m_tokens = m.counter("engine_tokens_generated_total",
+                                   "tokens sampled across all requests")
+        self._m_recompiles = m.counter(
+            "engine_decode_recompiles_total",
+            "decode-step compiles beyond warmup (invariant: 0)")
+        self._m_ttft = m.histogram("engine_ttft_seconds",
+                                   "submit -> first generated token")
+        self._m_per_token = m.histogram(
+            "engine_time_per_output_token_seconds",
+            "per-request decode latency per generated token")
+        self._m_prefill = m.histogram("engine_prefill_seconds",
+                                      "admission prefill wall time")
+        self._m_tick = m.histogram("engine_decode_tick_seconds",
+                                   "batched decode tick wall time")
+        self._m_slots.set(num_slots)
 
     # ----- jitted device steps --------------------------------------------
 
@@ -137,6 +185,21 @@ class InferenceEngine:
         # (the whole point of a slot cache); XLA:CPU can't donate and
         # would warn every compile
         return (1,) if jax.default_backend() != "cpu" else ()
+
+    def _commit(self, tree):
+        """Place host-built arrays COMMITTED on the device, so a step's
+        first call (host-uploaded carry/caches) and its steady state
+        (jit outputs, always committed) share ONE jit cache entry. With
+        any committed argument in the mix — which checkpoint-loaded
+        params always are — mixed committedness otherwise splits the
+        decode step into two compiled signatures, i.e. a wasted compile
+        per engine that the decode_recompiles counter flags (and did:
+        that is how this path was found). Mesh-ambient engines leave
+        placement to GSPMD, as before."""
+        if self.mesh is not None:
+            return tree
+        sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+        return jax.tree.map(lambda a: jax.device_put(a, sharding), tree)
 
     def _build_decode_step(self):
         cfg, vocab, wlp = self.cfg, self.vocab_size, self.want_logprobs
@@ -221,21 +284,28 @@ class InferenceEngine:
 
     def submit(self, req: Request) -> Request:
         """Queue a request; returns it (wait on req.done)."""
+        req.submit_time = time.monotonic()
         p = len(req.prompt)
         if p == 0:
             req._finish("empty prompt")
+            self.stats["rejected"] += 1
+            self._m_rejected.inc()
             return req
         if req.max_new_tokens < 1:
             req._finish("max_new_tokens must be >= 1")
+            self.stats["rejected"] += 1
+            self._m_rejected.inc()
             return req
         if p + req.max_new_tokens > self.max_seq_len:
             req._finish(
                 f"prompt ({p}) + max_new_tokens ({req.max_new_tokens}) "
                 f"exceeds engine max_seq_len {self.max_seq_len}")
             self.stats["rejected"] += 1
+            self._m_rejected.inc()
             return req
         with self._cv:
             self._queue.append(req)
+            self._m_queue.set(len(self._queue))
             self._cv.notify_all()
         return req
 
@@ -262,6 +332,14 @@ class InferenceEngine:
         req = self.slots[i]
         self._clear_slot(i)
         self.stats["retired"] += 1
+        self._m_retired.inc()
+        self._m_active.set(self.num_active)
+        if req.first_token_time is not None and len(req.generated) > 1:
+            # steady-state decode latency: exclude the prefill-produced
+            # first token (that's what TTFT measures)
+            self._m_per_token.observe(
+                (time.monotonic() - req.first_token_time)
+                / (len(req.generated) - 1))
         # drop the device carry: it still holds this slot's sampling
         # knobs, and a stale temperature/top_k>0 row would keep the
         # batched sampler's lax.cond filter branch (the [N, V] sort) live
@@ -294,6 +372,7 @@ class InferenceEngine:
             P = self._bucket(p)
             toks = np.zeros((1, P), np.int32)
             toks[0, :p] = req.prompt
+            t_prefill = time.monotonic()
             try:
                 tok, lp, plp, caches, key = self._prefill_step(P)(
                     self.params, self.caches, jnp.asarray(toks),
@@ -305,6 +384,7 @@ class InferenceEngine:
                 # not strand it un-signalled and kill the step loop
                 req._finish(f"prefill failed: {e}")
                 self.stats["rejected"] += 1
+                self._m_rejected.inc()
                 if self._donate():
                     # the failed call may have consumed the donated cache
                     # buffers — continuing would poison every active slot
@@ -315,9 +395,11 @@ class InferenceEngine:
                         if other is not None:
                             self._clear_slot(j)
                             other._finish(f"prefill failed: {e}")
-                    self.caches = _init_caches(self.cfg, self.num_slots,
-                                               self.max_seq_len,
-                                               int8=self.kv_cache_int8)
+                    self.caches = self._commit(
+                        _init_caches(self.cfg, self.num_slots,
+                                     self.max_seq_len,
+                                     int8=self.kv_cache_int8))
+                    self._m_active.set(self.num_active)
                 continue
             self.caches = caches
             self.slots[i] = req
@@ -331,6 +413,16 @@ class InferenceEngine:
             req.logprobs.append(float(lp))
             req.prompt_logprobs = [float(x) for x in plp[:p - 1]]
             self.stats["admitted"] += 1
+            now = time.monotonic()
+            req.first_token_time = now
+            self._m_prefill.observe(now - t_prefill)
+            if req.submit_time is not None:
+                self._m_ttft.observe(now - req.submit_time)
+            self._m_admitted.inc()
+            self._m_tokens.inc()
+            self._m_active.set(self.num_active)
+            with self._cv:
+                self._m_queue.set(len(self._queue))
             n += 1
             if self._req_finished(req):
                 self._retire(i)
@@ -350,13 +442,15 @@ class InferenceEngine:
             return 0
         active = [i for i, s in enumerate(self.slots) if s is not None]
         if self._carry is None:
-            self._carry = (jnp.asarray(self.last_tok),
-                           jnp.asarray(self.lengths),
-                           jnp.asarray(self.keys),
-                           jnp.asarray(self.temps),
-                           jnp.asarray(self.top_ks),
-                           jnp.asarray(self.top_ps))
+            self._carry = self._commit(
+                (jnp.asarray(self.last_tok),
+                 jnp.asarray(self.lengths),
+                 jnp.asarray(self.keys),
+                 jnp.asarray(self.temps),
+                 jnp.asarray(self.top_ks),
+                 jnp.asarray(self.top_ps)))
         last, lens, keys, temps, top_ks, top_ps = self._carry
+        t_tick = time.monotonic()
         try:
             toks, lps, caches, keys, lens = self._decode_step(
                 self.params, self.caches, last, lens, keys, temps, top_ks,
@@ -369,10 +463,11 @@ class InferenceEngine:
                 req = self.slots[i]
                 self._clear_slot(i)
                 req._finish(f"decode step failed: {e}")
+            self._m_active.set(self.num_active)
             self._carry = None
-            self.caches = _init_caches(self.cfg, self.num_slots,
-                                       self.max_seq_len,
-                                       int8=self.kv_cache_int8)
+            self.caches = self._commit(
+                _init_caches(self.cfg, self.num_slots, self.max_seq_len,
+                             int8=self.kv_cache_int8))
             raise
         self.caches = caches
         # toks/lens/keys chain into the next tick on device; only the
@@ -381,6 +476,13 @@ class InferenceEngine:
         toks = np.asarray(toks)
         lps = np.asarray(lps)
         self.stats["ticks"] += 1
+        self._m_ticks.inc()
+        self._m_tick.observe(time.monotonic() - t_tick)
+        self._m_tokens.inc(len(active))
+        self._track_decode_recompiles()
+        if self.flight_recorder is not None:
+            self.flight_recorder.heartbeat(
+                f"tick {self.stats['ticks']} ({len(active)} active)")
         for i in active:
             req = self.slots[i]
             # the fed token is now in the cache; the sampled one is next up
@@ -392,6 +494,23 @@ class InferenceEngine:
             if self._req_finished(req):
                 self._retire(i)
         return len(active)
+
+    def _track_decode_recompiles(self) -> None:
+        """Enforce the zero-recompiles-after-warmup invariant as a live
+        counter: the decode step's jit cache may grow by exactly ONE entry
+        (warmup); any growth past that means a traced-vs-static leak crept
+        in (e.g. a sampling knob going static) and every further tick is
+        paying a compile."""
+        try:
+            size = int(self._decode_step._cache_size())
+        except Exception:  # noqa: BLE001 - private API; tracking degrades
+            return
+        if size > self._decode_cache_seen:
+            grew = size - self._decode_cache_seen
+            if self._decode_cache_seen >= 1:  # beyond the warmup compile
+                self.stats["decode_recompiles"] += grew
+                self._m_recompiles.inc(grew)
+            self._decode_cache_seen = size
 
     # ----- driving ---------------------------------------------------------
 
@@ -470,7 +589,15 @@ class InferenceEngine:
                     with self._cv:
                         while (not self._stop and self.num_active == 0
                                and not self._queue):
-                            self._cv.wait()
+                            if self.flight_recorder is not None:
+                                # an IDLE engine is healthy, not hung: keep
+                                # beating (bounded wait) or the watchdog
+                                # dumps a spurious stall bundle — fatally
+                                # so under flight_recorder_abort
+                                self.flight_recorder.heartbeat("idle")
+                                self._cv.wait(timeout=1.0)
+                            else:
+                                self._cv.wait()
                         if self._stop:
                             return
                     try:
@@ -518,3 +645,5 @@ class InferenceEngine:
         for req in leftovers:
             req._finish("engine stopped")
         self._carry = None
+        self._m_active.set(0)
+        self._m_queue.set(0)

@@ -1,5 +1,11 @@
 """REST text-generation server.
 
+Observability: GET /metrics returns the process metrics registry in
+Prometheus text format (slot occupancy, queue depth, TTFT and per-token
+latency histograms, admitted/retired counters, HTTP request counters —
+docs/observability.md) and GET /healthz a liveness probe, alongside the
+generation API below.
+
 Equivalent of megatron/text_generation_server.py (241 LoC,
 Flask + flask_restful) on the stdlib http.server — PUT/POST /api with the
 same request schema:
@@ -27,15 +33,18 @@ from __future__ import annotations
 import json
 import contextlib
 import threading
+import time
 
 import jax
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any
+from typing import Any, Optional
 
 from megatron_tpu.config import ModelConfig
 from megatron_tpu.inference.api import (
     beam_search_and_post_process, generate_and_post_process,
 )
+from megatron_tpu.telemetry.http import PROMETHEUS_CONTENT_TYPE
+from megatron_tpu.telemetry.metrics import MetricsRegistry, default_registry
 
 MAX_TOKENS_TO_GENERATE = 1024  # ref caps requests similarly
 MAX_PROMPTS = 128
@@ -44,7 +53,8 @@ MAX_PROMPTS = 128
 class GenerationService:
     def __init__(self, cfg: ModelConfig, params: Any, tokenizer,
                  mesh=None, forward_fn=None, kv_cache_int8=False,
-                 engine_slots: int = 0, engine_max_seq_len=None):
+                 engine_slots: int = 0, engine_max_seq_len=None,
+                 metrics: Optional[MetricsRegistry] = None):
         """mesh + forward_fn serve sharded models: the mesh becomes
         ambient around generation (GSPMD handles tp/cp), forward_fn is the
         pp>1 pipelined forward (ref ForwardStep, forward_step.py:45-204).
@@ -70,6 +80,14 @@ class GenerationService:
         self.forward_fn = forward_fn
         self.kv_cache_int8 = kv_cache_int8
         self.lock = threading.Lock()
+        # one registry serves /metrics: the engine's slot/latency
+        # collectors and the HTTP layer's request counters both land here
+        self.metrics = metrics if metrics is not None else default_registry()
+        self._m_requests = self.metrics.counter(
+            "server_requests_total", "API requests by outcome",
+            label_names=("status",))
+        self._m_latency = self.metrics.histogram(
+            "server_request_seconds", "API request wall time")
         self.engine = None
         if engine_slots:
             from megatron_tpu.inference.engine import InferenceEngine
@@ -78,7 +96,8 @@ class GenerationService:
                 cfg, params, num_slots=engine_slots,
                 max_seq_len=engine_max_seq_len,
                 kv_cache_int8=kv_cache_int8,
-                vocab_size=tokenizer.vocab_size, mesh=mesh)
+                vocab_size=tokenizer.vocab_size, mesh=mesh,
+                metrics=self.metrics)
             self.engine.start()
 
     def shutdown(self) -> None:
@@ -160,17 +179,48 @@ def make_handler(service: GenerationService):
             self.wfile.write(body)
 
         def _handle(self):
+            t0 = time.monotonic()
+            status = "500"
             try:
                 length = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(length) or b"{}")
-                self._reply(200, service.handle(req))
+                payload = service.handle(req)
+                status = "200"
+                self._reply(200, payload)
             except ValueError as e:
+                status = "400"
                 self._reply(400, {"message": str(e)})
             except Exception as e:  # noqa: BLE001 — server must not die
                 self._reply(500, {"message": f"internal error: {e}"})
+            finally:
+                service._m_requests.inc(status=status)
+                service._m_latency.observe(time.monotonic() - t0)
 
         do_PUT = _handle
         do_POST = _handle
+
+        def do_GET(self):
+            # observability endpoints (Prometheus scrape + liveness); the
+            # generation API stays PUT/POST /api
+            path = self.path.split("?", 1)[0]
+            if path == "/metrics":
+                body = service.metrics.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", PROMETHEUS_CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif path == "/healthz":
+                alive = (service.engine is None
+                         or service.engine._thread is None
+                         or service.engine._thread.is_alive())
+                self._reply(200 if alive else 500,
+                            {"ok": bool(alive),
+                             "engine": service.engine is not None})
+            else:
+                self._reply(404, {"message": "GET serves /metrics and "
+                                             "/healthz; the API is "
+                                             "PUT/POST /api"})
 
         def log_message(self, *a):  # quiet
             pass

@@ -67,8 +67,33 @@ def logits_spec() -> P:
     return P(BATCH_AXES, AXIS_CONTEXT, AXIS_TENSOR)
 
 
+def _bound_axis_names():
+    """Axis names currently bound by an enclosing shard_map/*map body —
+    i.e. the MANUAL axes at this trace point. Private-API probe (no public
+    accessor on jax 0.4.37); fail-soft to 'none bound'."""
+    try:
+        from jax._src import core as _core
+
+        return set(_core.unsafe_get_axis_names())
+    except Exception:  # noqa: BLE001 - jax-internals drift => assume auto
+        return set()
+
+
 def constrain(x: jax.Array, spec: P) -> jax.Array:
-    """Apply a sharding constraint inside jit (requires mesh context)."""
+    """Apply a sharding constraint inside jit (requires mesh context).
+
+    Inside a FULL-manual shard_map body (the only mode the compat shim's
+    jax.shard_map offers on jax 0.4.37 — megatron_tpu/compat.py) a
+    constraint over manual axes is meaningless — every axis is already
+    manual, there is nothing left for GSPMD to place — and this jax
+    rejects it at lowering (too late for a try/except here). Current jax
+    keeps non-axis_names axes automatic and the constraint matters, so
+    the constraint is skipped ONLY when one of its axes is actually bound
+    manual at this trace point."""
+    spec_axes = {a for part in spec if part is not None
+                 for a in ((part,) if isinstance(part, str) else part)}
+    if spec_axes & _bound_axis_names():
+        return x
     return jax.lax.with_sharding_constraint(x, spec)
 
 

@@ -1,0 +1,37 @@
+"""Unified telemetry: event journal, metrics, goodput, flight recorder.
+
+One subsystem shared by training and serving (ISSUE 4):
+
+  * journal      crash-safe append-only JSONL of structured run events
+                 (per-step records, checkpoint/rollback/fault events) —
+                 the ground truth tools/telemetry_report.py summarizes
+  * metrics      Prometheus-expositable counters/gauges/histograms,
+                 scraped via /metrics on the serving server or the
+                 --metrics_port sidecar on the train loop
+  * goodput      wall-clock split into productive vs. stall categories +
+                 the jit recompile tracker (zero-after-warmup invariant)
+  * flight       heartbeat watchdog that dumps all-thread stacks + the
+    recorder     journal tail to a bundle when a step/tick stalls
+
+docs/observability.md documents the journal schema, metric names, and
+goodput definitions.
+"""
+
+from megatron_tpu.telemetry.flight_recorder import (  # noqa: F401
+    FlightRecorder, dump_all_stacks,
+)
+from megatron_tpu.telemetry.goodput import (  # noqa: F401
+    CATEGORIES, GoodputTracker, RecompileTracker, recompile_tracker,
+)
+from megatron_tpu.telemetry.http import (  # noqa: F401
+    MetricsServer, start_metrics_server,
+)
+from megatron_tpu.telemetry.journal import (  # noqa: F401
+    EventJournal, get_global_journal, read_events, set_global_journal,
+)
+from megatron_tpu.telemetry.metrics import (  # noqa: F401
+    Counter, Gauge, Histogram, MetricsRegistry, default_registry,
+)
+from megatron_tpu.telemetry.run import (  # noqa: F401
+    RunTelemetry, for_training,
+)

@@ -1,0 +1,158 @@
+"""Goodput accounting: where did the wall-clock go, and was it training?
+
+Goodput = productive step seconds / total wall seconds. Everything else
+is attributed to a named stall category so regressions are diagnosable
+("goodput dropped 4 points" is useless; "checkpoint_stall grew 4 points
+after the save interval changed" is a fix):
+
+  productive        forward+backward+optimizer device time actually
+                    advancing the model (compile time subtracted)
+  compile           jit tracing + XLA backend compiles (RecompileTracker)
+  data_wait         blocked on the input pipeline
+  checkpoint_stall  train-loop stall of a save (async: barrier + host copy)
+  rollback_replay   divergence rollback + fast-forward through the poison
+                    window (post-crash replay is the same bucket)
+  eval              validation loops
+  other             unattributed remainder (loop overhead, logging, ...)
+
+The recompile side doubles as a runtime invariant: the serving engine's
+"zero recompiles after warmup" (PR 1) stops being a bench footnote and
+becomes an assertable counter (tests/test_telemetry.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+#: canonical category names (journal `goodput` events and the report tool
+#: rely on these strings)
+CATEGORIES = ("productive", "compile", "data_wait", "checkpoint_stall",
+              "rollback_replay", "eval", "other")
+
+
+class GoodputTracker:
+    """Wall-clock ledger over the categories above."""
+
+    def __init__(self, clock=time.perf_counter):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._t0 = clock()
+        self._seconds: Dict[str, float] = {c: 0.0 for c in CATEGORIES}
+
+    def attribute(self, category: str, seconds: float) -> None:
+        if category not in self._seconds:
+            raise ValueError(
+                f"unknown goodput category {category!r}; one of {CATEGORIES}")
+        if seconds < 0:
+            return
+        with self._lock:
+            self._seconds[category] += seconds
+
+    class _Span:
+        def __init__(self, tracker, category):
+            self._tracker, self._category = tracker, category
+
+        def __enter__(self):
+            self._start = self._tracker._clock()
+            return self
+
+        def __exit__(self, *exc):
+            self._tracker.attribute(
+                self._category, self._tracker._clock() - self._start)
+            return False
+
+    def track(self, category: str) -> "GoodputTracker._Span":
+        """with tracker.track("eval"): ..."""
+        if category not in self._seconds:
+            raise ValueError(
+                f"unknown goodput category {category!r}; one of {CATEGORIES}")
+        return self._Span(self, category)
+
+    def report(self) -> Dict[str, float]:
+        """{"wall_s", "goodput", per-category seconds, "other_s"} — other
+        absorbs the unattributed remainder so the split always sums to
+        wall (concurrent attributions, e.g. an async-checkpoint commit
+        overlapping compute, can push the sum past wall; other floors at
+        0 and goodput stays productive/wall either way)."""
+        with self._lock:
+            wall = max(self._clock() - self._t0, 1e-9)
+            seconds = dict(self._seconds)
+        attributed = sum(v for k, v in seconds.items() if k != "other")
+        seconds["other"] += max(wall - attributed - seconds["other"], 0.0)
+        out = {"wall_s": round(wall, 4),
+               "goodput": round(seconds["productive"] / wall, 4)}
+        for c in CATEGORIES:
+            out[f"{c}_s"] = round(seconds[c], 4)
+        return out
+
+
+# -- recompile tracking -------------------------------------------------------
+#
+# jax.monitoring emits '/jax/core/compile/backend_compile_duration' once per
+# XLA backend compile (and the jaxpr-trace / mlir-lowering phases under
+# sibling names). Listeners cannot be unregistered individually on this jax
+# (clear_event_listeners would nuke everyone's), so the tracker is a
+# process-global install-once singleton and consumers diff snapshots.
+
+_BACKEND_COMPILE = "/jax/core/compile/backend_compile_duration"
+_TRACE = "/jax/core/compile/jaxpr_trace_duration"
+_LOWER = "/jax/core/compile/jaxpr_to_mlir_module_duration"
+
+
+class RecompileTracker:
+    """Counts XLA backend compiles (jit cache misses reaching the
+    compiler) and their total seconds, via jax.monitoring."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.compiles = 0
+        self.compile_seconds = 0.0
+        self.trace_seconds = 0.0
+
+    def _on_duration(self, name: str, secs: float, **kw) -> None:
+        with self._lock:
+            if name == _BACKEND_COMPILE:
+                self.compiles += 1
+                self.compile_seconds += secs
+            elif name in (_TRACE, _LOWER):
+                self.trace_seconds += secs
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return {"compiles": self.compiles,
+                    "compile_seconds": self.compile_seconds,
+                    "trace_seconds": self.trace_seconds}
+
+    def delta(self, since: Dict[str, float]) -> Dict[str, float]:
+        now = self.snapshot()
+        return {k: now[k] - since[k] for k in now}
+
+
+_tracker: Optional[RecompileTracker] = None
+_tracker_lock = threading.Lock()
+
+
+def recompile_tracker() -> RecompileTracker:
+    """The process-wide tracker, installing the jax.monitoring listener on
+    first use. Importing jax here (not module top) keeps the telemetry
+    package importable for tools that only read journals."""
+    global _tracker
+    with _tracker_lock:
+        if _tracker is None:
+            t = RecompileTracker()
+            try:
+                from jax import monitoring
+
+                monitoring.register_event_duration_secs_listener(
+                    t._on_duration)
+            except Exception as e:  # noqa: BLE001 - count stays 0; the
+                # zero-recompile assertion degrades to vacuous rather than
+                # taking serving down over a jax-internals change
+                import sys
+
+                print(f"telemetry: jax.monitoring unavailable ({e}); "
+                      "recompile tracking disabled", file=sys.stderr)
+            _tracker = t
+        return _tracker

@@ -1,0 +1,165 @@
+"""Per-run telemetry bundle for the train loop (and batch tools).
+
+One object owning the enabled subset of {journal, goodput ledger,
+recompile tracker, metrics collectors, sidecar /metrics server, flight
+recorder}, so megatron_tpu/training/pretrain.py wires telemetry with a
+handful of calls instead of six objects' lifecycles. Construction is
+driven by TrainingConfig's telemetry fields; everything is optional and
+for_training() returns None when nothing is enabled (zero overhead for
+runs that don't ask).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+from megatron_tpu.telemetry.flight_recorder import FlightRecorder
+from megatron_tpu.telemetry.goodput import GoodputTracker, recompile_tracker
+from megatron_tpu.telemetry.http import MetricsServer, start_metrics_server
+from megatron_tpu.telemetry.journal import (
+    JOURNAL_NAME, EventJournal, set_global_journal,
+)
+from megatron_tpu.telemetry.metrics import MetricsRegistry, default_registry
+
+
+class RunTelemetry:
+    """The enabled telemetry components of one training run."""
+
+    def __init__(self, journal: Optional[EventJournal],
+                 goodput: GoodputTracker,
+                 metrics: MetricsRegistry,
+                 server: Optional[MetricsServer],
+                 flight: Optional[FlightRecorder]):
+        self.journal = journal
+        self.goodput = goodput
+        self.recompiles = recompile_tracker()
+        self.metrics = metrics
+        self.server = server
+        self.flight = flight
+        # train-side collectors (get-or-create: stable across restarts in
+        # one process, shared with anything else publishing to `metrics`)
+        self.steps_total = metrics.counter(
+            "train_steps_total", "optimizer steps completed")
+        self.tokens_total = metrics.counter(
+            "train_tokens_total", "tokens consumed by completed steps")
+        self.recompiles_total = metrics.gauge(
+            "jit_backend_compiles_total",
+            "XLA backend compiles in this process (jit cache misses)")
+        self.loss_gauge = metrics.gauge(
+            "train_loss", "last completed step's loss")
+        self.goodput_gauge = metrics.gauge(
+            "train_goodput", "productive fraction of wall-clock so far")
+        self.step_seconds = metrics.histogram(
+            "train_step_seconds", "per-step wall time")
+        self.stall_seconds = metrics.counter(
+            "train_stall_seconds_total",
+            "non-productive wall seconds by category",
+            label_names=("category",))
+
+    # -- event plumbing -----------------------------------------------------
+
+    def emit(self, kind: str, **fields: Any) -> None:
+        if self.journal is not None:
+            self.journal.emit(kind, **fields)
+
+    def heartbeat(self, note: str = "") -> None:
+        if self.flight is not None:
+            self.flight.heartbeat(note)
+
+    def compile_snapshot(self) -> Dict[str, float]:
+        return self.recompiles.snapshot()
+
+    def step(self, iteration: int, step_s: float, ntokens: int,
+             compile_delta: Dict[str, float], **fields: Any) -> None:
+        """One completed optimizer step: journal record + metrics +
+        goodput attribution (compile seconds carved out of the span)."""
+        compile_s = (compile_delta.get("compile_seconds", 0.0)
+                     + compile_delta.get("trace_seconds", 0.0))
+        compile_s = min(max(compile_s, 0.0), step_s)
+        self.goodput.attribute("compile", compile_s)
+        self.goodput.attribute("productive", step_s - compile_s)
+        self.steps_total.inc()
+        self.tokens_total.inc(ntokens)
+        self.step_seconds.observe(step_s)
+        snap = self.recompiles.snapshot()
+        self.recompiles_total.set(snap["compiles"])
+        if "loss" in fields and fields["loss"] is not None:
+            self.loss_gauge.set(fields["loss"])
+        rec = dict(fields)
+        rec.update(iteration=iteration, step_ms=round(step_s * 1e3, 3),
+                   ntokens=int(ntokens))
+        if compile_s > 0:
+            rec["compile_ms"] = round(compile_s * 1e3, 3)
+            rec["compiles"] = int(compile_delta.get("compiles", 0))
+        self.emit("step", **rec)
+
+    def stall(self, category: str, seconds: float, **fields: Any) -> None:
+        """Attribute a named non-productive span + journal it."""
+        self.goodput.attribute(category, seconds)
+        self.stall_seconds.inc(max(seconds, 0.0), category=category)
+        self.emit(category, seconds=round(seconds, 4), **fields)
+
+    def goodput_report(self) -> Dict[str, float]:
+        rep = self.goodput.report()
+        self.goodput_gauge.set(rep["goodput"])
+        return rep
+
+    def close(self) -> None:
+        """Final goodput event, then tear down server/recorder/journal."""
+        try:
+            self.emit("goodput", final=True, **self.goodput_report())
+            self.emit("run_end")
+        finally:
+            if self.flight is not None:
+                self.flight.stop()
+            if self.server is not None:
+                self.server.close()
+            if self.journal is not None:
+                set_global_journal(None)
+                self.journal.flush()
+                self.journal.close()
+
+
+def for_training(tcfg, log=print, registry: Optional[MetricsRegistry] = None
+                 ) -> Optional[RunTelemetry]:
+    """Build the RunTelemetry a TrainingConfig asks for, or None.
+
+    telemetry_dir enables the journal (and gives the flight recorder its
+    bundle dir); metrics_port enables the sidecar /metrics listener (None
+    disables; 0 binds a free port — tests read it back off server.port);
+    flight_recorder arms the watchdog.
+    """
+    journal_on = bool(tcfg.telemetry_dir)
+    server_on = tcfg.metrics_port is not None
+    flight_on = bool(tcfg.flight_recorder)
+    if not (journal_on or server_on or flight_on):
+        return None
+    metrics = registry if registry is not None else default_registry()
+    journal = None
+    if journal_on:
+        # join the canonical name explicitly: telemetry_dir may not exist
+        # yet, which would defeat EventJournal's dir-vs-file sniffing
+        journal = EventJournal(
+            os.path.join(tcfg.telemetry_dir, JOURNAL_NAME),
+            max_bytes=int(tcfg.journal_max_mb * (1 << 20)))
+        set_global_journal(journal)
+    server = None
+    if server_on:
+        server = start_metrics_server(metrics, int(tcfg.metrics_port))
+        log(f"telemetry: /metrics listening on port {server.port}")
+    flight = None
+    if flight_on:
+        base = tcfg.telemetry_dir or tcfg.save
+        out = (os.path.join(base, "flight_bundles") if base
+               else "flight_bundles")
+        flight = FlightRecorder(
+            out_dir=out,
+            deadline_s=tcfg.flight_recorder_deadline_s,
+            journal=journal,
+            abort=tcfg.flight_recorder_abort,
+            log=log).start()
+        log(f"telemetry: flight recorder armed "
+            f"(deadline {tcfg.flight_recorder_deadline_s:.0f}s, "
+            f"abort={tcfg.flight_recorder_abort})")
+    return RunTelemetry(journal, GoodputTracker(), metrics, server, flight)

@@ -422,11 +422,16 @@ class AsyncCheckpointSaver:
     wait()/save()/close() rather than lost."""
 
     def __init__(self, save: str, keep_latest_k: Optional[int] = None,
-                 log=None, async_save: bool = True):
+                 log=None, async_save: bool = True, journal=None):
+        """journal: optional telemetry EventJournal — checkpoint begin /
+        commit events land there (the commit from the finalizer thread,
+        which is the point: the journal shows how long after the train
+        loop moved on the checkpoint actually became durable)."""
         self.save_dir = os.path.abspath(save)
         self.keep_latest_k = keep_latest_k
         self.log = log or (lambda _m: None)
         self.async_save = async_save
+        self.journal = journal
         os.makedirs(self.save_dir, exist_ok=True)
         stale = cleanup_staging(self.save_dir)
         if stale:
@@ -443,6 +448,12 @@ class AsyncCheckpointSaver:
         self.wait()  # barrier: at most one checkpoint in flight
         stage = _staging_dir(self.save_dir, iteration)
         shutil.rmtree(stage, ignore_errors=True)
+        import time as _time
+
+        t_begin = _time.perf_counter()
+        if self.journal is not None:
+            self.journal.emit("checkpoint_begin", iteration=iteration,
+                              async_save=self.async_save)
         # returns once device->host copies are done; the write continues on
         # orbax's background thread (donation-safe: the train step may
         # reuse these buffers immediately)
@@ -454,6 +465,11 @@ class AsyncCheckpointSaver:
                 self._last_path = _finalize(
                     self.save_dir, stage, iteration, consumed_samples,
                     config, self.keep_latest_k, self.log)
+                if self.journal is not None:
+                    self.journal.emit(
+                        "checkpoint_commit", iteration=iteration,
+                        path=self._last_path, async_save=self.async_save,
+                        seconds=round(_time.perf_counter() - t_begin, 4))
             except BaseException as e:  # noqa: BLE001 - re-raised at wait()
                 self._error = e
 

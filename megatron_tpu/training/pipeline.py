@@ -100,7 +100,9 @@ def _stage_fn(cfg: ModelConfig, chunk_layers: Any, x: jnp.ndarray,
     """Run one chunk's contiguous slice of layers (lax.scan over Lv).
     global_offset = index of the chunk's first layer in the full network
     (for per-layer LIMA dropout rates and dropout key folding).
-    Returns (x, moe_aux_sum) — aux is a zero scalar for dense models."""
+    Returns (x, moe_aux_sum) — aux is a zero [1]-vector for dense models
+    (shape [1], not scalar: rank-0 accumulators crossing a differentiated
+    shard_map scan trip jax 0.4.37's residual naming, see pipelined())."""
     rates_all = _layer_dropout_rates(cfg)  # [L] per-global-layer rates
 
     def body(carry, scanned):
@@ -119,9 +121,15 @@ def _stage_fn(cfg: ModelConfig, chunk_layers: Any, x: jnp.ndarray,
     # block:N remats only the first N of this chunk's layers (the
     # reference applies the budget per pipeline stage)
     (x, aux), _ = scan_with_remat(
-        body, (x, jnp.zeros((), jnp.float32)),
+        body, (x, jnp.zeros((1,), jnp.float32)),
         (chunk_layers, jnp.arange(layers_per_chunk)), recompute)
     return x, aux
+
+
+def _reshape1(out):
+    """(x, aux) with aux coerced to shape [1] (see _stage_fn docstring)."""
+    x, aux = out
+    return x, aux.reshape(1)
 
 
 def vpp_place_indices(L: int, Pn: int, V: int):
@@ -329,12 +337,17 @@ def make_pipeline_loss_fn(
                                      pos_m, key_t, global_offset, Lv,
                                      recompute, sharder=sharder)
 
+                # NB: every cross-tick accumulator below is kept [1]-shaped,
+                # not scalar: jax 0.4.37's shard_map partial-eval mis-names
+                # rank-0 residuals of differentiated bodies (_SpecError,
+                # a {0: axes} spec on a float32[] residual), so scalars may
+                # only appear after the final psum, outside the scan
                 if gate_bubbles:
                     out, stage_aux = jax.lax.cond(
-                        valid, run_stage,
-                        lambda x: (x, jnp.zeros((), jnp.float32)), x)
+                        valid, lambda x: _reshape1(run_stage(x)),
+                        lambda x: (x, jnp.zeros((1,), jnp.float32)), x)
                 else:
-                    out, stage_aux = run_stage(x)
+                    out, stage_aux = _reshape1(run_stage(x))
                     stage_aux = jnp.where(valid, stage_aux, 0.0)
 
                 def with_loss(_):
@@ -350,10 +363,12 @@ def make_pipeline_loss_fn(
                     else:
                         logits = lm_logits(model_cfg, params_local, h)
                         _, per_tok = cross_entropy_loss(logits, lab)
-                    return jnp.sum(per_tok * lm), jnp.sum(lm)
+                    return (jnp.sum(per_tok * lm).reshape(1),
+                            jnp.sum(lm).reshape(1))
 
                 def without_loss(_):
-                    return jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)
+                    return (jnp.zeros((1,), jnp.float32),
+                            jnp.zeros((1,), jnp.float32))
 
                 lsum, lcnt = jax.lax.cond(
                     is_last & (c == V - 1) & valid, with_loss, without_loss,
@@ -367,8 +382,8 @@ def make_pipeline_loss_fn(
                 (mbs, S, model_cfg.hidden_size),
                 model_cfg.dtype,
             )
-            carry0 = (h0, jnp.zeros((), jnp.float32),
-                      jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
+            carry0 = (h0, jnp.zeros((1,), jnp.float32),
+                      jnp.zeros((1,), jnp.float32), jnp.zeros((1,), jnp.float32))
             if seg is None:
                 (state, loss_sum, tok_sum, aux_sum), _ = jax.lax.scan(
                     tick, carry0, jnp.arange(T))
@@ -408,7 +423,8 @@ def make_pipeline_loss_fn(
             # per-microbatch-averaged unpipelined loss (ref: schedules.py
             # loss averaging + gpt_model.py:18 last-stage loss assembly)
             aux_sum = jax.lax.psum(aux_sum, "pipe") / M
-            return (loss_sum / jnp.maximum(tok_sum, 1.0), tok_sum, aux_sum)
+            return ((loss_sum / jnp.maximum(tok_sum, 1.0))[0], tok_sum[0],
+                    aux_sum[0])
 
         other = {k: v for k, v in params.items() if k != "layers"}
         in_specs = (

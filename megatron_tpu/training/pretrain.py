@@ -240,6 +240,19 @@ class TrainLoop:
             wandb_name=run_cfg.training.wandb_name,
             config=run_cfg.to_dict())
 
+        # unified telemetry (megatron_tpu/telemetry): event journal,
+        # goodput ledger, /metrics sidecar, flight recorder — None unless
+        # the config enables a component (docs/observability.md)
+        from megatron_tpu import telemetry as _telemetry
+
+        self.telemetry = _telemetry.for_training(t, log=self.log)
+        if self.telemetry is not None:
+            self.telemetry.emit(
+                "run_start", iteration=self.iteration,
+                consumed_samples=self.consumed_samples,
+                mesh={k: int(v) for k, v in dict(self.rt.mesh.shape).items()},
+                model_flops_per_token_fwd=model_cfg.flops_per_token_fwd())
+
     # -- placed (interleaved) layer order -----------------------------------
 
     def _permute_state(self, state, to_placed: bool):
@@ -294,10 +307,17 @@ class TrainLoop:
         if self._saver is None:
             self._saver = checkpointing.AsyncCheckpointSaver(
                 t.save, keep_latest_k=t.keep_latest_k, log=self.log,
-                async_save=t.async_save)
+                async_save=t.async_save,
+                journal=(self.telemetry.journal if self.telemetry else None))
         self._saver.save(state, self.iteration, self.consumed_samples,
                          config=self.cfg.to_dict())
         self.timers("save-checkpoint", 0).stop()
+        if self.telemetry is not None:
+            # the span above is the train-loop STALL (async: barrier +
+            # host copy), i.e. wall-clock the step loop did NOT train
+            self.telemetry.stall(
+                "checkpoint_stall", self.timers.last_s("save-checkpoint"),
+                iteration=self.iteration)
 
     def _flush_saves(self):
         """Barrier on any in-flight checkpoint write — the forced flush on
@@ -313,6 +333,11 @@ class TrainLoop:
         t = self.cfg.training
         diag = (f"divergence sentinel tripped at iteration "
                 f"{self.iteration}: {reason}")
+        if self.telemetry is not None:
+            self.telemetry.emit(
+                "divergence", iteration=self.iteration, reason=reason,
+                action=("rollback" if t.rollback_on_divergence
+                        and self._rollbacks < t.max_rollbacks else "abort"))
         if not t.rollback_on_divergence:
             self.log(diag + " — aborting (use --rollback_on_divergence "
                      "to auto-recover from the last good checkpoint)")
@@ -331,6 +356,7 @@ class TrainLoop:
                 diag + " — no --save/--load directory to roll back to")
         self._flush_saves()  # never roll back onto a half-written save
         trip_iter = self.iteration
+        t_rollback = time.perf_counter()
         state = None
         errors = []
         for src in sources:
@@ -351,6 +377,13 @@ class TrainLoop:
         self._rollbacks += 1
         self._skip_data_until = trip_iter
         self._sentinel.reset()
+        if self.telemetry is not None:
+            # the fast-forward through [it, trip_iter) is attributed
+            # per-iteration in the loop; this covers the restore itself
+            self.telemetry.stall(
+                "rollback_replay", time.perf_counter() - t_rollback,
+                event="restore", from_iteration=trip_iter, to_iteration=it,
+                rollback=self._rollbacks)
         self.log(f"{diag} — rolled back to checkpoint at iteration {it} "
                  f"(rollback {self._rollbacks}/{t.max_rollbacks}); "
                  f"fast-forwarding data through iteration {trip_iter} to "
@@ -548,6 +581,10 @@ class TrainLoop:
             # exception) barriers on the in-flight async checkpoint write
             # so a committed tracker is what the next resume finds
             self._flush_saves()
+            if self.telemetry is not None:
+                # after the flush so the last checkpoint_commit event is
+                # in the journal before the final goodput line
+                self.telemetry.close()
 
     def _train_inner(self, train_iter_factory, valid_iter_factory):
         t = self.cfg.training
@@ -595,6 +632,12 @@ class TrainLoop:
                 fast_forward = self.iteration < self._skip_data_until
                 skipped_iter = (fast_forward
                                 or (self.iteration + 1) in t.skip_iters)
+                if self.telemetry is not None:
+                    # a fast-forward's data fetch is replay cost, not
+                    # input-pipeline wait
+                    self.telemetry.goodput.attribute(
+                        "rollback_replay" if fast_forward else "data_wait",
+                        self.timers.last_s("batch-generator"))
                 # trace-window management must see skipped iterations too,
                 # or a skip at the boundary strands the trace open/closed
                 self._profile_window()
@@ -608,6 +651,13 @@ class TrainLoop:
                     self.log(f"iteration {self.iteration}: update skipped "
                              + ("(post-rollback fast-forward)"
                                 if fast_forward else "(--skip_iters)"))
+                    if self.telemetry is not None:
+                        self.telemetry.emit(
+                            "step_skipped", iteration=self.iteration,
+                            reason=("rollback_fast_forward" if fast_forward
+                                    else "skip_iters"))
+                        self.telemetry.heartbeat(
+                            f"iteration {self.iteration} (skipped)")
                 else:
                     resilience.maybe_kill("kill_at", self.iteration + 1)
                     if resilience.fault_active("nan_loss", self.iteration + 1):
@@ -618,10 +668,32 @@ class TrainLoop:
                     # region here (the reference's separate spans,
                     # training.py:500-525, would break that fusion);
                     # --profile gives the op-level breakdown instead
+                    compile_snap = (self.telemetry.compile_snapshot()
+                                    if self.telemetry is not None else None)
                     self.timers("forward-backward-optimizer", 0).start()
                     metrics = self.train_step(batch)
                     loss_host = float(metrics["loss"])  # host sync
                     self.timers("forward-backward-optimizer", 0).stop()
+                    ntok = batch.get("tokens",
+                                     next(iter(batch.values()))).size
+                    if self.telemetry is not None:
+                        step_s = self.timers.last_s(
+                            "forward-backward-optimizer")
+                        self.telemetry.step(
+                            self.iteration, step_s, ntok,
+                            self.telemetry.recompiles.delta(compile_snap),
+                            loss=loss_host,
+                            lr=float(metrics["lr"]),
+                            grad_norm=float(metrics["grad_norm"]),
+                            skipped=bool(float(metrics.get("skipped", 0.0))),
+                            data_wait_ms=round(self.timers.last_s(
+                                "batch-generator") * 1e3, 3),
+                            tokens_per_s=round(ntok / max(step_s, 1e-9), 1),
+                            model_tflops_per_s=round(
+                                ntok / max(step_s, 1e-9)
+                                * model_flops_per_token / 1e12, 3))
+                        self.telemetry.heartbeat(
+                            f"iteration {self.iteration}")
 
                     if self._sentinel is not None:
                         streak = metrics.get("skip_streak")
@@ -654,8 +726,6 @@ class TrainLoop:
                             self.timers.elapsed_ms(reset=True)
                             continue
 
-                    ntok = batch.get("tokens",
-                                     next(iter(batch.values()))).size
                     window_tokens += ntok
                     loss_avg += loss_host
                     loss_n += 1
@@ -723,6 +793,10 @@ class TrainLoop:
                     ts = self.timers.log_string(normalizer=max(loss_n, 1))
                     if ts:
                         self.log(ts)
+                    if self.telemetry is not None:
+                        self.telemetry.emit(
+                            "goodput", iteration=self.iteration,
+                            **self.telemetry.goodput_report())
                     self.writer.flush()
                     window_tokens, window_t0 = 0, time.time()
                     loss_avg, loss_n = 0.0, 0
@@ -732,6 +806,13 @@ class TrainLoop:
                     self.timers("eval-time", 0).start()
                     ev = self.evaluate(valid_iter_factory(), t.eval_iters)
                     self.timers("eval-time", 0).stop()
+                    if self.telemetry is not None:
+                        self.telemetry.stall(
+                            "eval", self.timers.last_s("eval-time"),
+                            iteration=self.iteration,
+                            lm_loss=float(ev["lm_loss"]))
+                        self.telemetry.heartbeat(
+                            f"iteration {self.iteration} (post-eval)")
                     extra = " | ".join(f"{k}: {v:.4f}" for k, v in ev.items()
                                        if k not in ("lm_loss", "ppl"))
                     self.log(f"validation | lm loss: {ev['lm_loss']:.6f} | "
@@ -758,6 +839,9 @@ class TrainLoop:
                     t.save_interval and self.iteration % t.save_interval == 0)
                 if saved_now or should_exit:
                     self.save()
+                    if self.telemetry is not None:
+                        self.telemetry.heartbeat(
+                            f"iteration {self.iteration} (post-save)")
                 if should_exit:
                     return self.state
                 last_saved = self.iteration if saved_now else None

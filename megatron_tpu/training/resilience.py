@@ -94,6 +94,18 @@ def fault_active(kind: str, iteration: int) -> bool:
     return iteration == args[0]
 
 
+def _journal_fault(kind: str, **fields) -> None:
+    """Record the injected fault in the telemetry journal (when the run
+    has one): a post-mortem of a faulted test run should show the fault
+    the way a real incident timeline would show the preemption."""
+    from megatron_tpu.telemetry import journal as tj
+
+    j = tj.get_global_journal()
+    if j is not None:
+        j.emit("fault_injection", fault=kind, **fields)
+        j.flush()  # kill_* faults SIGKILL right after; make the line land
+
+
 def maybe_kill(kind: str, iteration: int) -> None:
     """SIGKILL this process if the fault is armed for `iteration` — an
     unmaskable death, like a preemption or OOM kill, so nothing downstream
@@ -103,6 +115,7 @@ def maybe_kill(kind: str, iteration: int) -> None:
             f"MEGATRON_TPU_FAULT: {kind} firing at iteration {iteration} — "
             "killing process\n")
         sys.stderr.flush()
+        _journal_fault(kind, iteration=iteration)
         os.kill(os.getpid(), signal.SIGKILL)
 
 
@@ -112,6 +125,7 @@ def maybe_sleep(kind: str = "slow_save") -> None:
     if args:
         import time
 
+        _journal_fault(kind, ms=args[0])
         time.sleep(args[0] / 1000.0)
 
 
@@ -120,6 +134,7 @@ def poison_batch(batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
     loss_mask makes the masked-mean loss NaN, its grads non-finite, and the
     optimizer skip the step (found-inf path) — exactly what a fp16 overflow
     or corrupted batch produces, with no mocked metrics."""
+    _journal_fault("nan_loss")
     out = dict(batch)
     ref = out.get("loss_mask")
     if ref is None:

@@ -209,10 +209,14 @@ def make_t5_pipeline_loss_fn(
                                         other["embed"]["tokens"])
                     _, per_tok = cross_entropy_loss(logits, idx(labels))
                     lm = idx(loss_mask)
-                    return jnp.sum(per_tok * lm), jnp.sum(lm)
+                    # [1]-shaped, not scalar: rank-0 residuals of a
+                    # differentiated shard_map body trip jax 0.4.37's
+                    # partial-eval spec naming (see pipeline.py pipelined())
+                    return (jnp.sum(per_tok * lm).reshape(1),
+                            jnp.sum(lm).reshape(1))
 
                 def without_loss(_):
-                    z = jnp.zeros((), jnp.float32)
+                    z = jnp.zeros((1,), jnp.float32)
                     return z, z
 
                 lsum, lcnt = jax.lax.cond(is_last & (c == 1) & valid,
@@ -226,7 +230,7 @@ def make_t5_pipeline_loss_fn(
             h0 = jnp.zeros((mbs, Smax, model_cfg.hidden_size),
                            model_cfg.dtype)
             e0 = jnp.zeros((mbs, Se, model_cfg.hidden_size), model_cfg.dtype)
-            z = jnp.zeros((), jnp.float32)
+            z = jnp.zeros((1,), jnp.float32)
             carry0 = (h0, e0, z, z)
             if seg is None:
                 (x, enc_out, loss_sum, tok_sum), _ = jax.lax.scan(
@@ -255,7 +259,7 @@ def make_t5_pipeline_loss_fn(
                     segment, carry0, tick_ids)
             loss_sum = jax.lax.psum(loss_sum, "pipe")
             tok_sum = jax.lax.psum(tok_sum, "pipe")
-            return loss_sum / jnp.maximum(tok_sum, 1.0), tok_sum
+            return (loss_sum / jnp.maximum(tok_sum, 1.0))[0], tok_sum[0]
 
         in_specs = (
             jax.tree.map(lambda _: P("pipe"), enc_layers),

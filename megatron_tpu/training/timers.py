@@ -20,6 +20,7 @@ class _Timer:
         self._start: Optional[float] = None
         self._elapsed = 0.0
         self._count = 0
+        self._last = 0.0
 
     def start(self):
         if self._start is not None:
@@ -29,7 +30,8 @@ class _Timer:
     def stop(self):
         if self._start is None:
             raise RuntimeError(f"timer {self.name} not started")
-        self._elapsed += time.perf_counter() - self._start
+        self._last = time.perf_counter() - self._start
+        self._elapsed += self._last
         self._count += 1
         self._start = None
 
@@ -46,6 +48,13 @@ class _Timer:
         return out
 
 
+    def last(self) -> float:
+        """Duration of the most recently completed span (not reset by
+        elapsed() — the telemetry journal reads per-step spans while the
+        log-interval window keeps accumulating)."""
+        return self._last
+
+
 class _DummyTimer:
     def start(self):
         pass
@@ -54,6 +63,9 @@ class _DummyTimer:
         pass
 
     def elapsed(self, reset: bool = True) -> float:
+        return 0.0
+
+    def last(self) -> float:
         return 0.0
 
 
@@ -78,6 +90,12 @@ class Timers:
         names = names if names is not None else sorted(self._timers)
         return {n: self._timers[n].elapsed(reset) * 1000.0
                 for n in names if n in self._timers}
+
+    def last_s(self, name: str) -> float:
+        """Most recent completed span of `name` in SECONDS (0.0 for a
+        never-stopped or below-log-level timer) — per-step telemetry."""
+        t = self._timers.get(name)
+        return t.last() if t is not None else 0.0
 
     def log_string(self, names=None, normalizer: float = 1.0,
                    reset: bool = True) -> str:

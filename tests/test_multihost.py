@@ -9,8 +9,18 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# This jax's XLA:CPU client cannot execute cross-process programs: a
+# device_put of a host array to a non-addressable sharding (each process
+# holds only its slice of the global batch) routes through a multihost
+# broadcast that the CPU backend rejects with exactly this message. On a
+# real TPU backend the same code path works; the test must skip, not fail,
+# so the suite stays green on CPU CI while still running under
+# MEGATRON_TPU_TEST_PLATFORM=tpu captures (ROADMAP open item).
+_CPU_MULTIHOST_UNSUPPORTED = "Multiprocess computations aren't implemented"
 
 _WORKER = r"""
 import os, sys
@@ -97,8 +107,21 @@ def test_two_process_distributed_step(tmp_path):
              for i in range(2)]
     outs = []
     for p in procs:
-        out, _ = p.communicate(timeout=600)
+        try:
+            out, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            # the peer of a crashed worker can wedge in a collective;
+            # collect what it printed and let the skip check below decide
+            p.kill()
+            out, _ = p.communicate()
         outs.append(out)
+    if any(_CPU_MULTIHOST_UNSUPPORTED in out for out in outs):
+        pytest.skip(
+            "this jax's CPU backend cannot device_put to a non-addressable "
+            f"sharding ({_CPU_MULTIHOST_UNSUPPORTED!r}: the per-host batch "
+            "placement routes through a multihost broadcast XLA:CPU does "
+            "not implement); run with MEGATRON_TPU_TEST_PLATFORM=tpu for "
+            "real multi-process coverage")
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out}"
     losses = []

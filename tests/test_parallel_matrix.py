@@ -15,11 +15,17 @@ keeps the guard's full coverage while making the suite deterministic.
 
 import os
 import subprocess
+
+import pytest
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@pytest.mark.slow  # revived by the compat jax.shard_map shim (PR 4):
+# the child pytest now runs all 18 topology cases (~2 min of XLA:CPU
+# compiles on the 2-core tier-1 host); pp2/tp2/vpp coverage stays in
+# tier-1 via test_pipeline / test_training
 def test_topology_matrix_in_fresh_process():
     # start from a clean platform env; the child's pytest run loads
     # tests/conftest.py which does force_cpu(8) as usual

@@ -36,7 +36,14 @@ def _setup(pp, tp=1, num_layers=4, n_micro=4, mbs=2, seq=16, vocab=64):
     return cfg, rt, params, batch
 
 
-@pytest.mark.parametrize("pp,tp", [(2, 1), (4, 1), (2, 2)])
+@pytest.mark.parametrize("pp,tp", [
+    # each point is its own ~3-11s XLA:CPU compile on the 2-core
+    # tier-1 host; grads_match_unpipelined[2] keeps pp2 parity (fwd
+    # loss included) in tier-1, the pp2xtp2 point rides along cheap
+    pytest.param(2, 1, marks=pytest.mark.slow),
+    (2, 2),
+    pytest.param(4, 1, marks=pytest.mark.slow),
+])
 def test_pipeline_loss_matches_unpipelined(pp, tp):
     cfg, rt, params, batch = _setup(pp, tp=tp)
     pp_loss_fn = make_pipeline_loss_fn(cfg, rt.mesh, num_stages=pp,
@@ -48,7 +55,8 @@ def test_pipeline_loss_matches_unpipelined(pp, tp):
     assert float(aux["ntokens"]) == batch["tokens"].size
 
 
-@pytest.mark.parametrize("pp", [2, 4])
+@pytest.mark.parametrize(
+    "pp", [2, pytest.param(4, marks=pytest.mark.slow)])
 def test_pipeline_grads_match_unpipelined(pp):
     cfg, rt, params, batch = _setup(pp)
     pp_loss_fn = make_pipeline_loss_fn(cfg, rt.mesh, num_stages=pp,
@@ -61,6 +69,9 @@ def test_pipeline_grads_match_unpipelined(pp):
                                    rtol=5e-4, atol=1e-5)
 
 
+@pytest.mark.slow  # newly revived by the compat jax.shard_map shim
+# (PR 4): XLA:CPU compile-heavy on the 2-core tier-1 host; the pp2
+# loss/grads parity tests keep the schedule covered in tier-1
 def test_pipeline_train_step_descends():
     cfg, rt, params, batch = _setup(2)
     opt_cfg = OptimizerConfig(lr=1e-2, lr_decay_style="constant")
@@ -81,6 +92,9 @@ def test_pipeline_train_step_descends():
     assert last < first * 0.7, (first, last)
 
 
+@pytest.mark.slow  # newly revived by the compat jax.shard_map shim
+# (PR 4): XLA:CPU compile-heavy on the 2-core tier-1 host; the pp2
+# loss/grads parity tests keep the schedule covered in tier-1
 def test_pipeline_bubble_gate_saves_walltime():
     """Quantify the schedule taxes (VERDICT r2 weak #4): measure jitted
     fwd+bwd wall-clock for (a) unpipelined, (b) pp2 gated, (c) pp2
@@ -147,6 +161,9 @@ def test_pipeline_gated_pure_pp_with_production_sharder():
     np.testing.assert_allclose(float(loss_pp), float(loss_ref), rtol=1e-5)
 
 
+@pytest.mark.slow  # newly revived by the compat jax.shard_map shim
+# (PR 4): XLA:CPU compile-heavy on the 2-core tier-1 host; the pp2
+# loss/grads parity tests keep the schedule covered in tier-1
 def test_pipeline_gating_on_sharded_mesh_matches_ungated():
     """r4 measured attempt (VERDICT #10): for the BARE loss fn, gating a
     tensor/data-sharded stage body is correct (parity here) and 9%
@@ -201,6 +218,9 @@ def test_pipeline_gating_on_sharded_mesh_matches_ungated():
     # (test_parallel_matrix.py), which runs every combo through auto
 
 
+@pytest.mark.slow  # newly revived by the compat jax.shard_map shim
+# (PR 4): XLA:CPU compile-heavy on the 2-core tier-1 host; the pp2
+# loss/grads parity tests keep the schedule covered in tier-1
 def test_pipeline_block_recompute_matches_unpipelined():
     """block:N remat through the pipeline (per-chunk layer budget, ref
     transformer.py:1148-1172) — loss and grads stay exact."""
@@ -229,7 +249,8 @@ def test_pipeline_rejects_indivisible_layers():
         make_pipeline_loss_fn(cfg, rt.mesh, num_stages=3, num_microbatches=4)
 
 
-@pytest.mark.parametrize("pp,vpp", [(2, 2), (4, 2)])
+@pytest.mark.parametrize("pp,vpp", [
+    (2, 2), pytest.param(4, 2, marks=pytest.mark.slow)])
 def test_interleaved_vpp_loss_matches_unpipelined(pp, vpp):
     """Interleaved (virtual-pipeline) schedule parity: round-robin chunk
     placement + the same ring must reproduce the unpipelined loss
@@ -245,6 +266,9 @@ def test_interleaved_vpp_loss_matches_unpipelined(pp, vpp):
     assert float(aux["ntokens"]) == batch["tokens"].size
 
 
+@pytest.mark.slow  # newly revived by the compat jax.shard_map shim
+# (PR 4): XLA:CPU compile-heavy on the 2-core tier-1 host; the pp2
+# loss/grads parity tests keep the schedule covered in tier-1
 def test_interleaved_vpp_grads_match_unpipelined():
     cfg, rt, params, batch = _setup(2, num_layers=4, n_micro=4)
     pp_loss_fn = make_pipeline_loss_fn(cfg, rt.mesh, num_stages=2,
@@ -265,6 +289,9 @@ def test_interleaved_vpp_microbatch_constraint():
                               recompute="full", num_virtual_chunks=2)
 
 
+@pytest.mark.slow  # newly revived by the compat jax.shard_map shim
+# (PR 4): XLA:CPU compile-heavy on the 2-core tier-1 host; the pp2
+# loss/grads parity tests keep the schedule covered in tier-1
 def test_pipeline_train_loop_with_data_parallel():
     """dp>1 x pp through the full TrainLoop (regression: data-sharded batch
     tensors entering the pipe-manual region forced GSPMD resharding
@@ -294,6 +321,8 @@ def test_pipeline_train_loop_with_data_parallel():
     assert float(m2["loss"]) < float(m1["loss"])
 
 
+@pytest.mark.slow  # newly revived (compat shard_map shim); two full
+# remat compiles at ~10s each on the 2-core tier-1 host
 @pytest.mark.parametrize("vpp", [1, 2])
 def test_pipeline_segment_remat_parity(vpp):
     """Segmented tick-scan remat (1F1B-like memory bound) must not change
@@ -315,6 +344,8 @@ def test_pipeline_segment_remat_parity(vpp):
                                    rtol=1e-5, atol=1e-7)
 
 
+@pytest.mark.slow  # newly revived (compat shard_map shim); ~11s of
+# pp2xVPP compiles + a checkpoint round-trip on the 2-core host
 def test_vpp_placed_storage_parity_and_checkpoint(tmp_path):
     """TrainLoop stores layers in placed order under VPP: first-step loss
     must equal the canonical pipeline loss on the same init, and
@@ -355,8 +386,12 @@ def test_vpp_placed_storage_parity_and_checkpoint(tmp_path):
             lambda p, b: ref_fn(p, b, None)[0])(ref_params, batch))
     np.testing.assert_allclose(float(m1["loss"]), ref_loss, rtol=1e-5)
 
-    # checkpoint round-trip into a pp=1 (no VPP) topology
+    # checkpoint round-trip into a pp=1 (no VPP) topology. Barrier on the
+    # async commit first: this test predates AsyncCheckpointSaver (it was
+    # dormant on the jax.shard_map AttributeError when PR 2 landed) and
+    # loading before the finalizer thread commits would race it
     loop.save()
+    loop._flush_saves()
     cfg1 = RunConfig(
         model=model, parallel=ParallelConfig(),
         optimizer=OptimizerConfig(lr=1e-3, lr_decay_style="constant"),

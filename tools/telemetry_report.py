@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""Summarize a telemetry event journal (docs/observability.md).
+
+    python tools/telemetry_report.py runs/tele/events.jsonl
+    python tools/telemetry_report.py runs/tele            # dir => events.jsonl
+    python tools/telemetry_report.py runs/tele --json     # machine-readable
+
+Reads the append-only JSONL journal a training run writes under
+--telemetry_dir (rotated segments included automatically) and reports:
+
+  * goodput %: productive step seconds over wall-clock, with the stall
+    split (checkpoint stalls, data waits, compile, rollback replay, eval)
+  * stall top-list: the longest individual non-productive events, so "the
+    run lost 4% to checkpoint_stall" comes with the receipts
+  * latency percentiles: per-step wall time p50/p90/p99 (+ tokens/s), the
+    training counterpart of the serving histograms on /metrics
+
+No jax import — this runs anywhere, including laptops reading journals
+scp'd off a pod. bench.py attaches the same goodput split to its headline
+JSON line (detail["goodput"]).
+"""
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from megatron_tpu.telemetry.goodput import CATEGORIES  # noqa: E402
+from megatron_tpu.telemetry.journal import JOURNAL_NAME, read_events  # noqa: E402
+
+#: journal kinds counted as discrete stall events for the top-list
+STALL_KINDS = ("checkpoint_stall", "eval", "rollback_replay")
+
+
+def load_journal(path: str) -> List[Dict[str, Any]]:
+    """All events, oldest first, across rotated segments (.N oldest)."""
+    if os.path.isdir(path):
+        path = os.path.join(path, JOURNAL_NAME)
+    if not os.path.exists(path) and not _segments(path):
+        raise FileNotFoundError(f"no journal at {path}")
+    events: List[Dict[str, Any]] = []
+    for seg in _segments(path) + ([path] if os.path.exists(path) else []):
+        evs, torn = read_events(seg)
+        events.extend(evs)
+        if torn is not None:
+            print(f"# note: {seg} ends in a torn line "
+                  "(crash mid-write; expected after a kill)",
+                  file=sys.stderr)
+    return events
+
+
+def _segments(path: str) -> List[str]:
+    out = []
+    i = 1
+    while os.path.exists(f"{path}.{i}"):
+        out.append(f"{path}.{i}")
+        i += 1
+    return list(reversed(out))  # oldest first
+
+
+def percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile over an already-sorted list."""
+    if not sorted_vals:
+        return float("nan")
+    idx = min(len(sorted_vals) - 1, max(0, round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def summarize(events: List[Dict[str, Any]], top_n: int = 5) -> Dict[str, Any]:
+    steps = [e for e in events if e.get("kind") == "step"]
+    goodputs = [e for e in events if e.get("kind") == "goodput"]
+    stalls = [e for e in events
+              if e.get("kind") in STALL_KINDS and "seconds" in e]
+    out: Dict[str, Any] = {
+        "events": len(events),
+        "steps": len(steps),
+        "checkpoints": sum(1 for e in events
+                           if e.get("kind") == "checkpoint_commit"),
+        "faults": [e.get("fault") for e in events
+                   if e.get("kind") == "fault_injection"],
+        "divergences": sum(1 for e in events
+                           if e.get("kind") == "divergence"),
+    }
+    if goodputs:
+        # goodput events are cumulative WITHIN one process; a journal that
+        # spans crash+resume holds several process segments (delimited by
+        # run_start), and summing only the last would let a run that lost
+        # hours to a crash report near-100% goodput. Take the last event
+        # of EACH segment and sum across them.
+        finals: List[Dict[str, Any]] = []
+        current: Dict[str, Any] = {}
+        for e in events:
+            if e.get("kind") == "run_start" and current:
+                finals.append(current)
+                current = {}
+            elif e.get("kind") == "goodput":
+                current = e
+        if current:
+            finals.append(current)
+        wall = sum(g.get("wall_s", 0.0) for g in finals)
+        productive = sum(g.get("productive_s", 0.0) for g in finals)
+        out["goodput_pct"] = round(100.0 * productive / max(wall, 1e-9), 2)
+        out["wall_s"] = round(wall, 4)
+        out["split_s"] = {c: round(sum(g.get(f"{c}_s", 0.0)
+                                       for g in finals), 4)
+                          for c in CATEGORIES}
+        if len(finals) > 1:
+            out["process_segments"] = len(finals)
+    out["stall_top"] = [
+        {"kind": e["kind"], "seconds": round(float(e["seconds"]), 4),
+         "iteration": e.get("iteration")}
+        for e in sorted(stalls, key=lambda e: -float(e["seconds"]))[:top_n]]
+    if steps:
+        ms = sorted(float(e["step_ms"]) for e in steps if "step_ms" in e)
+        out["step_ms"] = {"p50": round(percentile(ms, 0.50), 3),
+                          "p90": round(percentile(ms, 0.90), 3),
+                          "p99": round(percentile(ms, 0.99), 3),
+                          "max": round(ms[-1], 3)}
+        tps = sorted(float(e["tokens_per_s"]) for e in steps
+                     if "tokens_per_s" in e)
+        if tps:
+            out["tokens_per_s"] = {"p50": round(percentile(tps, 0.50), 1),
+                                   "max": round(tps[-1], 1)}
+        losses = [float(e["loss"]) for e in steps
+                  if isinstance(e.get("loss"), (int, float))]
+        if losses:
+            out["last_loss"] = round(losses[-1], 6)
+        compiles = sum(int(e.get("compiles", 0)) for e in steps)
+        out["step_compiles"] = compiles
+    return out
+
+
+def render(summary: Dict[str, Any]) -> str:
+    lines = [f"journal: {summary['events']} events, "
+             f"{summary['steps']} steps, "
+             f"{summary['checkpoints']} checkpoints committed"]
+    if "goodput_pct" in summary:
+        split = summary["split_s"]
+        parts = " | ".join(f"{c}: {split[c]:.1f}s" for c in CATEGORIES
+                           if split.get(c))
+        lines.append(f"goodput: {summary['goodput_pct']:.2f}% of "
+                     f"{summary['wall_s']:.1f}s wall ({parts})")
+    if summary.get("stall_top"):
+        lines.append("longest stalls:")
+        for s in summary["stall_top"]:
+            where = (f" @ iteration {s['iteration']}"
+                     if s.get("iteration") is not None else "")
+            lines.append(f"  {s['seconds']:9.3f}s  {s['kind']}{where}")
+    if "step_ms" in summary:
+        p = summary["step_ms"]
+        lines.append(f"step time ms: p50 {p['p50']} | p90 {p['p90']} | "
+                     f"p99 {p['p99']} | max {p['max']}")
+    if "tokens_per_s" in summary:
+        t = summary["tokens_per_s"]
+        lines.append(f"tokens/s: p50 {t['p50']} | max {t['max']}")
+    if summary.get("last_loss") is not None:
+        lines.append(f"last loss: {summary['last_loss']}")
+    if summary.get("faults"):
+        lines.append(f"injected faults: {summary['faults']}")
+    if summary.get("divergences"):
+        lines.append(f"divergence trips: {summary['divergences']}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("journal", help="journal file or its telemetry dir")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary as one JSON object")
+    ap.add_argument("--top", type=int, default=5,
+                    help="entries in the stall top-list")
+    args = ap.parse_args(argv)
+    summary = summarize(load_journal(args.journal), top_n=args.top)
+    print(json.dumps(summary, indent=1) if args.json else render(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
