@@ -196,6 +196,27 @@ def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
                    help="retention: prune all but the newest K committed "
                         "checkpoints after each save (default: keep all)")
 
+    g = p.add_argument_group("async loop")
+    g.add_argument("--no_async_loop", action="store_false", dest="async_loop",
+                   default=True,
+                   help="run the fully synchronous train loop (blocking "
+                        "data fetch, transfer, and metrics read each "
+                        "step) — the differential-test oracle; the async "
+                        "loop is bitwise-identical and the default")
+    g.add_argument("--prefetch_depth", type=int, default=2,
+                   help="device-side double-buffer depth of the "
+                        "background batch prefetcher (0 keeps placement "
+                        "on the critical path)")
+    g.add_argument("--metrics_lag", type=int, default=1,
+                   help="fetch step metrics K steps late so the next "
+                        "dispatch overlaps the current step; sentinel/"
+                        "logger/heartbeat see steps K late (bounded — "
+                        "docs/fault_tolerance.md)")
+    g.add_argument("--compilation_cache_dir", default=None,
+                   help="persistent XLA compilation cache dir: restarts "
+                        "pay the goodput `compile` bucket once (cache "
+                        "hits land in telemetry step records)")
+
     g = p.add_argument_group("fault tolerance")
     g.add_argument("--divergence_patience", type=int, default=100,
                    help="trip the divergence sentinel after this many "
@@ -594,6 +615,10 @@ def args_to_run_config(args) -> RunConfig:
         no_load_rng=args.no_load_rng,
         async_save=getattr(args, "async_save", True),
         keep_latest_k=getattr(args, "keep_latest_k", None),
+        async_loop=getattr(args, "async_loop", True),
+        prefetch_depth=getattr(args, "prefetch_depth", 2),
+        metrics_lag=getattr(args, "metrics_lag", 1),
+        compilation_cache_dir=getattr(args, "compilation_cache_dir", None),
         divergence_patience=getattr(args, "divergence_patience", 100),
         loss_spike_factor=getattr(args, "loss_spike_factor", 0.0),
         loss_spike_patience=getattr(args, "loss_spike_patience", 5),

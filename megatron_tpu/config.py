@@ -461,6 +461,27 @@ class TrainingConfig:
     # and whatever the tracker points at are never pruned); None = keep all
     keep_latest_k: Optional[int] = None
 
+    # async goodput loop (training/prefetch.py + the lagged-metrics train
+    # loop; docs/performance.md "Async goodput loop"). --no_async_loop
+    # restores the fully synchronous loop — it stays the differential-test
+    # oracle: loss curves are bitwise-identical between the two.
+    async_loop: bool = True
+    # bounded device-side double-buffer depth of the background batch
+    # prefetcher (>=1 when async_loop; 0 keeps host->device placement on
+    # the critical path even with the async loop on)
+    prefetch_depth: int = 2
+    # fetch step metrics (loss/lr/grad_norm) K steps late so dispatch of
+    # the next step overlaps the current one; the divergence sentinel,
+    # logger, goodput accounting and flight-recorder heartbeat all consume
+    # the lagged stream (sentinel trip latency grows by K — bounded; the
+    # rollback discards the in-flight steps, docs/fault_tolerance.md)
+    metrics_lag: int = 1
+    # persistent XLA compilation cache directory
+    # (jax_compilation_cache_dir): crash-resume restarts and re-runs pay
+    # the goodput `compile` bucket once; cache hits surface in step
+    # records and the recompile tracker
+    compilation_cache_dir: Optional[str] = None
+
     # divergence sentinel (training/resilience.py): abort — or roll back,
     # with rollback_on_divergence — after this many CONSECUTIVE
     # non-finite/skipped optimizer steps; 0 disables
@@ -570,6 +591,15 @@ class TrainingConfig:
             raise ValueError(
                 "journal_max_mb must be >= 0 (0 disables rotation: one "
                 "unbounded journal file)")
+        if self.prefetch_depth < 0:
+            raise ValueError(
+                "prefetch_depth must be >= 0 (0 disables the background "
+                "prefetcher; use --no_async_loop for the fully "
+                "synchronous loop)")
+        if self.metrics_lag < 0:
+            raise ValueError(
+                "metrics_lag must be >= 0 (0 fetches metrics inside each "
+                "step, the synchronous behavior)")
         if self.train_iters is None and self.train_samples is None:
             pass  # inference / tooling use
         return self

@@ -99,17 +99,34 @@ class GoodputTracker:
 _BACKEND_COMPILE = "/jax/core/compile/backend_compile_duration"
 _TRACE = "/jax/core/compile/jaxpr_trace_duration"
 _LOWER = "/jax/core/compile/jaxpr_to_mlir_module_duration"
+# plain (duration-less) events fired by jax's persistent compilation cache
+# on every backend-compile request when jax_compilation_cache_dir is set: a
+# hit skips the XLA compile entirely (no _BACKEND_COMPILE duration fires),
+# a miss compiles then writes the entry. Counting both makes the warm-start
+# story assertable: a resumed process with a warm cache shows hits > 0 and
+# a collapsed goodput `compile` bucket (tests/test_prefetch.py).
+_CACHE_HIT = "/jax/compilation_cache/cache_hits"
+_CACHE_MISS = "/jax/compilation_cache/cache_misses"
 
 
 class RecompileTracker:
     """Counts XLA backend compiles (jit cache misses reaching the
-    compiler) and their total seconds, via jax.monitoring."""
+    compiler) and their total seconds, plus persistent-compilation-cache
+    hits/misses, via jax.monitoring.
+
+    Caveat on this jax (0.4.37): the backend_compile duration event wraps
+    compile_or_get_cached, so a persistent-cache HIT still increments
+    `compiles` — with a near-zero duration. Warm-start assertions should
+    therefore read cache_hits and compile_seconds, not the compile count
+    (tests/test_prefetch.py)."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self.compiles = 0
         self.compile_seconds = 0.0
         self.trace_seconds = 0.0
+        self.cache_hits = 0
+        self.cache_misses = 0
 
     def _on_duration(self, name: str, secs: float, **kw) -> None:
         with self._lock:
@@ -119,11 +136,20 @@ class RecompileTracker:
             elif name in (_TRACE, _LOWER):
                 self.trace_seconds += secs
 
+    def _on_event(self, name: str, **kw) -> None:
+        with self._lock:
+            if name == _CACHE_HIT:
+                self.cache_hits += 1
+            elif name == _CACHE_MISS:
+                self.cache_misses += 1
+
     def snapshot(self) -> Dict[str, float]:
         with self._lock:
             return {"compiles": self.compiles,
                     "compile_seconds": self.compile_seconds,
-                    "trace_seconds": self.trace_seconds}
+                    "trace_seconds": self.trace_seconds,
+                    "cache_hits": self.cache_hits,
+                    "cache_misses": self.cache_misses}
 
     def delta(self, since: Dict[str, float]) -> Dict[str, float]:
         now = self.snapshot()
@@ -147,6 +173,7 @@ def recompile_tracker() -> RecompileTracker:
 
                 monitoring.register_event_duration_secs_listener(
                     t._on_duration)
+                monitoring.register_event_listener(t._on_event)
             except Exception as e:  # noqa: BLE001 - count stays 0; the
                 # zero-recompile assertion degrades to vacuous rather than
                 # taking serving down over a jax-internals change

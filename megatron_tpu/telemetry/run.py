@@ -56,6 +56,16 @@ class RunTelemetry:
             "train_stall_seconds_total",
             "non-productive wall seconds by category",
             label_names=("category",))
+        self.compile_cache_hits = metrics.gauge(
+            "jit_compile_cache_hits_total",
+            "persistent compilation cache hits in this process")
+        # the async loop's sync-freedom invariant as a live number: in
+        # steady state this advances by exactly 1 per step (the lagged
+        # metrics fetch); growth beyond that is a hidden host sync on the
+        # hot path (tests/test_prefetch.py regression-gates it)
+        self.host_syncs = metrics.counter(
+            "train_host_syncs_total",
+            "blocking device->host transfers issued by the train loop")
 
     # -- event plumbing -----------------------------------------------------
 
@@ -84,6 +94,7 @@ class RunTelemetry:
         self.step_seconds.observe(step_s)
         snap = self.recompiles.snapshot()
         self.recompiles_total.set(snap["compiles"])
+        self.compile_cache_hits.set(snap.get("cache_hits", 0))
         if "loss" in fields and fields["loss"] is not None:
             self.loss_gauge.set(fields["loss"])
         rec = dict(fields)
@@ -92,6 +103,11 @@ class RunTelemetry:
         if compile_s > 0:
             rec["compile_ms"] = round(compile_s * 1e3, 3)
             rec["compiles"] = int(compile_delta.get("compiles", 0))
+        # persistent-cache traffic for this step (a warm resume shows
+        # cache_hits with compiles == 0: the trace ran, XLA did not)
+        hits = int(compile_delta.get("cache_hits", 0))
+        if hits:
+            rec["cache_hits"] = hits
         self.emit("step", **rec)
 
     def stall(self, category: str, seconds: float, **fields: Any) -> None:

@@ -14,6 +14,7 @@ batch-size rampup, periodic eval, logging, checkpointing, graceful exit
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import dataclasses
 import os
@@ -36,7 +37,7 @@ from megatron_tpu.parallel.mesh import MeshRuntime, build_mesh
 from megatron_tpu.parallel.sharding import (
     activation_spec, batch_spec, constrain, shard_tree, tree_shardings,
 )
-from megatron_tpu.training import checkpointing, resilience
+from megatron_tpu.training import checkpointing, prefetch, resilience
 from megatron_tpu.training.microbatches import MicroBatchCalculator
 from megatron_tpu.training.optimizer import (
     TrainState, init_train_state, train_state_specs,
@@ -118,6 +119,33 @@ class TrainLoop:
         run_cfg.validate()
         self.cfg = run_cfg
         self.log = log
+        if run_cfg.training.compilation_cache_dir:
+            # persistent XLA compilation cache, wired BEFORE the first jit
+            # (init_params below compiles): a crash-resume restart or
+            # re-run pays the goodput `compile` bucket once. Threshold 0:
+            # the train loop's few big programs are exactly the re-paid
+            # cost, and tiny helper jits are noise either way. The config
+            # is PROCESS-GLOBAL and deliberately not restored on loop
+            # exit — eval/serving work after training in the same process
+            # should keep the cache; ephemeral consumers (bench's
+            # async_loop_bench) restore + reset_cache() themselves.
+            try:
+                jax.config.update("jax_compilation_cache_dir",
+                                  run_cfg.training.compilation_cache_dir)
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 0.0)
+                # a process that already compiled something WITHOUT a
+                # cache dir has latched jax's cache module into its
+                # disabled state (initialized-with-no-dir, never
+                # re-checked); reset so the dir just set takes effect
+                from jax.experimental.compilation_cache import (
+                    compilation_cache as _cc,
+                )
+
+                _cc.reset_cache()
+            except Exception as e:  # noqa: BLE001 - cache is best-effort
+                self.log(f"compilation cache unavailable ({e}); "
+                         "continuing without")
         if jax.process_count() > 1:
             # multi-host: DCN-aware mesh (data axis outermost across slices)
             from megatron_tpu.parallel.distributed import build_multihost_mesh
@@ -204,6 +232,16 @@ class TrainLoop:
         self._rollback_reset_after = 20 * max(
             t.divergence_patience, t.loss_spike_patience, 25)
 
+        # async goodput loop state (training/prefetch.py): the background
+        # batch prefetcher (rebuilt at every consumed_samples watermark
+        # change) and the count of blocking device->host syncs the loop
+        # has issued — the steady-state invariant is exactly one per step
+        # (the batched metrics fetch), regression-gated in
+        # tests/test_prefetch.py
+        self._prefetcher: Optional[prefetch.DevicePrefetcher] = None
+        self._pf_credited = (0.0, 0.0)
+        self.host_sync_points = 0
+
         sp = run_cfg.parallel.sequence_parallel
 
         def sharder(x, role):
@@ -251,7 +289,10 @@ class TrainLoop:
                 "run_start", iteration=self.iteration,
                 consumed_samples=self.consumed_samples,
                 mesh={k: int(v) for k, v in dict(self.rt.mesh.shape).items()},
-                model_flops_per_token_fwd=model_cfg.flops_per_token_fwd())
+                model_flops_per_token_fwd=model_cfg.flops_per_token_fwd(),
+                async_loop=t.async_loop, prefetch_depth=t.prefetch_depth,
+                metrics_lag=t.metrics_lag,
+                compilation_cache_dir=t.compilation_cache_dir)
 
     # -- placed (interleaved) layer order -----------------------------------
 
@@ -325,17 +366,25 @@ class TrainLoop:
         if self._saver is not None:
             self._saver.wait()
 
-    def _handle_divergence(self, reason: str) -> bool:
+    def _handle_divergence(self, reason: str,
+                           trip_iter: Optional[int] = None) -> bool:
         """Sentinel tripped: roll back to the newest valid checkpoint (with
         --rollback_on_divergence, while rollbacks remain) or raise
         DivergenceError with the full diagnostic. Returns True after a
-        rollback so the loop rebuilds its data iterator."""
+        rollback so the loop rebuilds its data iterator.
+
+        trip_iter is the iteration whose metrics tripped the sentinel —
+        with the async loop's lagged metrics it can be up to K behind
+        self.iteration; the in-flight steps past it are discarded by the
+        restore, and the fast-forward bound stays at trip_iter so the
+        post-rollback trajectory matches the synchronous loop's exactly."""
         t = self.cfg.training
+        trip_iter = self.iteration if trip_iter is None else trip_iter
         diag = (f"divergence sentinel tripped at iteration "
-                f"{self.iteration}: {reason}")
+                f"{trip_iter}: {reason}")
         if self.telemetry is not None:
             self.telemetry.emit(
-                "divergence", iteration=self.iteration, reason=reason,
+                "divergence", iteration=trip_iter, reason=reason,
                 action=("rollback" if t.rollback_on_divergence
                         and self._rollbacks < t.max_rollbacks else "abort"))
         if not t.rollback_on_divergence:
@@ -355,7 +404,6 @@ class TrainLoop:
             raise resilience.DivergenceError(
                 diag + " — no --save/--load directory to roll back to")
         self._flush_saves()  # never roll back onto a half-written save
-        trip_iter = self.iteration
         t_rollback = time.perf_counter()
         state = None
         errors = []
@@ -478,23 +526,94 @@ class TrainLoop:
 
         return {k: put(np.asarray(v)) for k, v in batch.items()}
 
+    def _transfer(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        """Host->device placement with honest spans: `batch-transfer-
+        dispatch` is the host cost of ISSUING the copies, `batch-transfer`
+        additionally waits for them to land (the sync may no-op on the
+        axon plugin — timers.py docstring), so neither span lies about
+        what it covers at any log level. Under the async loop the
+        prefetcher places batches on its worker thread and the loop
+        credits the same two spans from the worker's measurements
+        (_credit_prefetch_spans)."""
+        tm_all = self.timers("batch-transfer", 1)
+        tm_disp = self.timers("batch-transfer-dispatch", 1)
+        tm_all.start()
+        tm_disp.start()
+        device_batch = self._put_batch(batch)
+        tm_disp.stop()
+        if self.timers.log_level >= 1:
+            jax.block_until_ready(device_batch)
+        tm_all.stop()
+        return device_batch
+
     def train_step(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
-        gbs = next(iter(batch.values())).shape[0]
+        return self.train_step_placed(self._transfer(batch))
+
+    def train_step_placed(self, device_batch: Dict[str, Any]
+                          ) -> Dict[str, float]:
+        """Dispatch one optimizer step on an already device-resident batch
+        (the prefetcher's product). Returns DEVICE metrics — no host sync;
+        the caller decides when to pay it (_fetch_metrics)."""
+        gbs = next(iter(device_batch.values())).shape[0]
         n_micro = gbs // (self.cfg.training.micro_batch_size * self.rt.dp)
         step = self._train_step_for(max(n_micro, 1))
-        tm = self.timers("batch-transfer", 1)
-        tm.start()
-        device_batch = self._put_batch(batch)
-        if self.timers.log_level >= 1:
-            # device_put returns before the copy lands; sync so the span is
-            # truthful (may no-op on the axon plugin — timers.py docstring)
-            jax.block_until_ready(device_batch)
-        tm.stop()
         with jax.sharding.set_mesh(self.rt.mesh):
             self.state, metrics = step(self.state, device_batch)
         self.iteration += 1
         self.consumed_samples += gbs
         return metrics
+
+    def _fetch_metrics(self, metrics: Dict[str, Any]) -> Dict[str, Any]:
+        """ONE blocking device->host sync fetching every step metric at
+        once — the single permitted host sync per steady-state step (the
+        sync-freedom invariant: host_sync_points / train_host_syncs_total,
+        tests/test_prefetch.py)."""
+        self.host_sync_points += 1
+        if self.telemetry is not None:
+            self.telemetry.host_syncs.inc()
+        return jax.device_get(metrics)
+
+    # -- async-loop plumbing -------------------------------------------------
+
+    def _make_data_iter(self, factory, gbs: int, depth: int):
+        """Iterator of batches at the current consumed_samples watermark:
+        the raw host iterator (sync path), or a DevicePrefetcher that
+        pulls/places/lands batches on a background thread (async path).
+        The prefetcher's transform applies host-side fault injection with
+        the iteration each batch will be consumed at, so faults hit the
+        same batches in both modes."""
+        it = factory(self.consumed_samples, gbs)
+        if depth <= 0:
+            return it
+        self._prefetcher = prefetch.DevicePrefetcher(
+            it, self._put_batch, depth=depth,
+            first_iteration=self.iteration + 1,
+            transform=(lambda b, i:
+                       resilience.host_batch_faults(b, i, self.log)))
+        self._pf_credited = (0.0, 0.0)
+        return self._prefetcher
+
+    def _close_prefetcher(self) -> None:
+        if self._prefetcher is not None:
+            self._prefetcher.close()
+            self._prefetcher = None
+
+    def _credit_prefetch_spans(self) -> None:
+        """Surface the prefetch worker's transfer time in the loop's
+        timers (the spans the sync path records inline), as credited
+        deltas once per pop."""
+        pf = self._prefetcher
+        if pf is None:
+            return
+        # single read of the worker-updated counters: re-reading at store
+        # time would swallow any increment landing between delta and store
+        put_now, land_now = pf.put_s, pf.land_s
+        put, land = self._pf_credited
+        d_put, d_land = put_now - put, land_now - land
+        if d_put or d_land:
+            self._pf_credited = (put_now, land_now)
+            self.timers.record("batch-transfer-dispatch", d_put, level=1)
+            self.timers.record("batch-transfer", d_put + d_land, level=1)
 
     def evaluate(self, data_iter: Iterator, eval_iters: int) -> Dict[str, float]:
         """Forward-only eval (ref: training.py:773-826)."""
@@ -586,6 +705,162 @@ class TrainLoop:
                 # in the journal before the final goodput line
                 self.telemetry.close()
 
+    def _reset_log_window(self) -> None:
+        self._win_tokens = 0
+        self._win_t0 = time.time()
+        self._win_loss = 0.0
+        self._win_n = 0
+
+    def _process_record(self, rec: Dict[str, Any]) -> bool:
+        """Consume one pipeline record — a dispatched step's device
+        metrics, or a skipped iteration — in dispatch order: host-fetch,
+        journal/metrics, sentinel, log-window bookkeeping. With
+        --metrics_lag K the loop calls this K records behind dispatch, so
+        the single blocking fetch here overlaps the K newer steps already
+        in flight. Returns True when the sentinel tripped AND
+        _handle_divergence rolled back (the caller resets its pipeline);
+        a no-rollback trip raises DivergenceError out of here."""
+        it = rec["iteration"]
+        if "skip_reason" in rec:
+            fast_forward = rec["skip_reason"] == "rollback_fast_forward"
+            self.log(f"iteration {it}: update skipped "
+                     + ("(post-rollback fast-forward)" if fast_forward
+                        else "(--skip_iters)"))
+            if self.telemetry is not None:
+                self.telemetry.emit("step_skipped", iteration=it,
+                                    reason=rec["skip_reason"])
+                self.telemetry.heartbeat(f"iteration {it} (skipped)")
+            self._maybe_log_window(rec)
+            return False
+
+        host = rec["host"]
+        if host is None:
+            # lagged fetch: this wait is the device catching up — in
+            # steady state it IS the device step time, which the
+            # dispatch-only forward-backward-optimizer span cannot see
+            fm = self.timers("metrics-fetch", 0)
+            fm.start()
+            host = self._fetch_metrics(rec["metrics"])
+            fm.stop()
+            step_s = rec["dispatch_s"] + self.timers.last_s("metrics-fetch")
+        else:
+            # lag 0: the fetch already happened inside the span
+            step_s = rec["dispatch_s"]
+        loss_host = float(host["loss"])
+        self._last_host_metrics = host
+        ntok = rec["ntok"]
+        if self.telemetry is not None:
+            self.telemetry.step(
+                it, step_s, ntok, rec["compile_delta"],
+                loss=loss_host,
+                lr=float(host["lr"]),
+                grad_norm=float(host["grad_norm"]),
+                skipped=bool(float(host.get("skipped", 0.0))),
+                data_wait_ms=round(rec["data_wait_s"] * 1e3, 3),
+                tokens_per_s=round(ntok / max(step_s, 1e-9), 1),
+                model_tflops_per_s=round(
+                    ntok / max(step_s, 1e-9)
+                    * self._model_flops_per_token / 1e12, 3))
+            self.telemetry.heartbeat(f"iteration {it}")
+
+        if self._sentinel is not None:
+            streak = host.get("skip_streak")
+            step_skipped = bool(float(host.get("skipped", 0.0)))
+            trip = self._sentinel.observe(
+                loss_host, step_skipped,
+                streak=(int(float(streak)) if streak is not None
+                        else None))
+            if trip is None and not step_skipped:
+                self._healthy_steps += 1
+                if (self._rollbacks
+                        and it > self._skip_data_until
+                        and self._healthy_steps
+                        >= self._rollback_reset_after):
+                    self.log(
+                        f"sentinel: {self._healthy_steps} healthy"
+                        " steps since the last rollback —"
+                        " restoring the rollback budget")
+                    self._rollbacks = 0
+            else:
+                self._healthy_steps = 0
+            if trip and self._handle_divergence(trip, trip_iter=it):
+                return True
+
+        self._win_tokens += ntok
+        self._win_loss += loss_host
+        self._win_n += 1
+        self._maybe_log_window(rec)
+        return False
+
+    def _maybe_log_window(self, rec: Dict[str, Any]) -> None:
+        """Close the log window when the processed record's iteration hits
+        log_interval (record iterations arrive in order, so the cadence is
+        identical to the synchronous loop's)."""
+        t = self.cfg.training
+        it = rec["iteration"]
+        if it % t.log_interval != 0:
+            return
+        if self._win_n == 0:
+            # window had only skipped iterations: still close it (discard
+            # timer accumulation too, or the next window's per-iteration
+            # averages count two windows of elapsed)
+            self.log(f"iteration {it}/{t.train_iters} | "
+                     f"consumed samples: {rec['consumed']} | "
+                     "all iterations in window skipped")
+            self.timers.elapsed_ms(reset=True)
+            self._win_tokens, self._win_t0 = 0, time.time()
+            return
+        metrics = self._last_host_metrics
+        dt = time.time() - self._win_t0
+        tps = self._win_tokens / max(dt, 1e-9)
+        mfu_flops = tps * self._model_flops_per_token
+        self.log(
+            f"iteration {it}/{t.train_iters} | "
+            f"consumed samples: {rec['consumed']} | "
+            f"lm loss: {self._win_loss / max(self._win_n, 1):.6f} | "
+            f"lr: {float(metrics['lr']):.3e} | "
+            f"grad norm: {float(metrics['grad_norm']):.3f} | "
+            f"skipped: {int(metrics['skipped'])} | "
+            f"tokens/sec: {tps:,.0f} | "
+            f"model TFLOP/s: {mfu_flops / 1e12:.1f}")
+        self.writer.add_scalar("train/lm_loss",
+                               self._win_loss / max(self._win_n, 1), it)
+        self.writer.add_scalar("train/lr", float(metrics["lr"]), it)
+        self.writer.add_scalar("train/grad_norm",
+                               float(metrics["grad_norm"]), it)
+        self.writer.add_scalar("train/tokens_per_sec", tps, it)
+        if "num_zeros" in metrics:
+            self.writer.add_scalar(
+                "train/num_zeros", float(metrics["num_zeros"]), it)
+        if t.log_batch_size:
+            self.writer.add_scalar("train/global_batch_size",
+                                   rec["gbs"], it)
+        if t.log_world_size:
+            self.writer.add_scalar("train/world_size",
+                                   jax.device_count(), it)
+        if t.log_params_norm:
+            self.writer.add_scalar("train/params_norm",
+                                   self._params_norm(), it)
+        if t.log_memory:
+            for k, v in self._memory_stats().items():
+                self.writer.add_scalar(f"memory/{k}", v, it)
+        # per-span wall clock, averaged per iteration over the window
+        # (ref: timers.log / --log_timers_to_tensorboard,
+        # megatron/timers.py:79-96)
+        if t.log_timers_to_tensorboard:
+            for name, ms in self.timers.elapsed_ms(reset=False).items():
+                self.writer.add_scalar(
+                    f"timers/{name}", ms / max(self._win_n, 1), it)
+        ts = self.timers.log_string(normalizer=max(self._win_n, 1))
+        if ts:
+            self.log(ts)
+        if self.telemetry is not None:
+            self.telemetry.emit("goodput", iteration=it,
+                                **self.telemetry.goodput_report())
+        self.writer.flush()
+        self._win_tokens, self._win_t0 = 0, time.time()
+        self._win_loss, self._win_n = 0.0, 0
+
     def _train_inner(self, train_iter_factory, valid_iter_factory):
         t = self.cfg.training
         if t.eval_only:
@@ -596,38 +871,94 @@ class TrainLoop:
             self.log(f"validation | lm loss: {ev['lm_loss']:.6f} | "
                      f"ppl: {ev['ppl']:.3f}")
             return self.state
-        model_flops_per_token = 3.0 * self.cfg.model.flops_per_token_fwd()
+        self._model_flops_per_token = \
+            3.0 * self.cfg.model.flops_per_token_fwd()
         start_time = time.time()
-        window_tokens = 0
-        window_t0 = time.time()
-        loss_avg, loss_n = 0.0, 0
+        self._reset_log_window()
+        self._last_host_metrics = None
+
+        # Async goodput loop: dispatch-ahead with device-resident metrics.
+        # The prefetcher lands step N+1's batch while step N computes; lag
+        # K leaves up to K dispatched steps' metrics un-fetched so the
+        # host never blocks between pop and the next dispatch. Records
+        # flow through `pending` strictly in dispatch order; lag 0 + depth
+        # 0 IS the synchronous loop (--no_async_loop) — one code path, so
+        # the two modes are bitwise-identical by construction
+        # (tests/test_prefetch.py differential tests).
+        lag = max(t.metrics_lag, 0) if t.async_loop else 0
+        depth = max(t.prefetch_depth, 0) if t.async_loop else 0
+        pending: collections.deque = collections.deque()
 
         last_saved = None
         # a trace window still open at ANY exit from the loop (SIGTERM,
         # exit_interval, exhaustion, exception) must be closed or the
-        # profile file is corrupt
+        # profile file is corrupt; same for the prefetch worker
         with DistributedSignalHandler() as sig, contextlib.ExitStack() as _s:
             _s.callback(self._profile_stop)
+            _s.callback(self._close_prefetcher)
             data_iter = None
             current_gbs = None
-            while self.iteration < (t.train_iters or 0):
+
+            def drain(n_keep: int) -> bool:
+                """Process pending records down to n_keep, oldest first;
+                True if one tripped the sentinel into a rollback."""
+                while len(pending) > n_keep:
+                    if self._process_record(pending.popleft()):
+                        return True
+                return False
+
+            def on_rollback():
+                """Reset the loop's pipeline after _handle_divergence
+                reloaded the state: everything in flight (pending metric
+                records, prefetched batches) belongs to the discarded
+                trajectory, and the contaminated logging window goes too."""
+                nonlocal data_iter, current_gbs
+                pending.clear()
+                self._close_prefetcher()
+                data_iter = None
+                current_gbs = None
+                self._reset_log_window()
+                self.timers.elapsed_ms(reset=True)
+
+            while True:
+                if self.iteration >= (t.train_iters or 0):
+                    # drain the metrics pipeline before declaring victory:
+                    # a sentinel trip hiding in the tail rolls back and
+                    # resumes training instead of silently finishing
+                    if drain(0):
+                        on_rollback()
+                        continue
+                    break
                 gbs = self.calc.global_batch(self.consumed_samples)
                 if gbs != current_gbs or data_iter is None:
+                    self._close_prefetcher()
                     current_gbs = gbs
-                    data_iter = train_iter_factory(self.consumed_samples, gbs)
+                    data_iter = self._make_data_iter(
+                        train_iter_factory, gbs, depth)
 
                 self.timers("batch-generator", 0).start()
                 batch = next(data_iter, None)
                 if batch is None:
-                    # epoch boundary: ask the factory for a fresh iterator
-                    # (sampler order is a pure function of consumed_samples)
-                    data_iter = train_iter_factory(self.consumed_samples, gbs)
+                    # epoch boundary: fresh iterator at the exact
+                    # consumed_samples watermark (sampler order is a pure
+                    # function of consumed_samples; batches the prefetcher
+                    # pulled ahead were never counted, so none are lost)
+                    self._close_prefetcher()
+                    data_iter = self._make_data_iter(
+                        train_iter_factory, gbs, depth)
                     batch = next(data_iter, None)
                     if batch is None:
                         self.timers("batch-generator", 0).stop()
                         self.log("data exhausted, stopping")
+                        if drain(0):
+                            on_rollback()
+                            continue
                         break
                 self.timers("batch-generator", 0).stop()
+                # with the prefetcher this is pure queue-pop wait — ~0 in
+                # steady state, the whole point of the async loop
+                data_wait_s = self.timers.last_s("batch-generator")
+                self._credit_prefetch_spans()
 
                 fast_forward = self.iteration < self._skip_data_until
                 skipped_iter = (fast_forward
@@ -637,7 +968,7 @@ class TrainLoop:
                     # input-pipeline wait
                     self.telemetry.goodput.attribute(
                         "rollback_replay" if fast_forward else "data_wait",
-                        self.timers.last_s("batch-generator"))
+                        data_wait_s)
                 # trace-window management must see skipped iterations too,
                 # or a skip at the boundary strands the trace open/closed
                 self._profile_window()
@@ -648,161 +979,60 @@ class TrainLoop:
                     # SIGTERM / exit / save checks below still run
                     self.iteration += 1
                     self.consumed_samples += gbs
-                    self.log(f"iteration {self.iteration}: update skipped "
-                             + ("(post-rollback fast-forward)"
-                                if fast_forward else "(--skip_iters)"))
-                    if self.telemetry is not None:
-                        self.telemetry.emit(
-                            "step_skipped", iteration=self.iteration,
-                            reason=("rollback_fast_forward" if fast_forward
-                                    else "skip_iters"))
-                        self.telemetry.heartbeat(
-                            f"iteration {self.iteration} (skipped)")
+                    pending.append({
+                        "iteration": self.iteration, "gbs": gbs,
+                        "consumed": self.consumed_samples,
+                        "skip_reason": ("rollback_fast_forward"
+                                        if fast_forward else "skip_iters")})
                 else:
                     resilience.maybe_kill("kill_at", self.iteration + 1)
-                    if resilience.fault_active("nan_loss", self.iteration + 1):
-                        batch = resilience.poison_batch(batch)
-                        self.log("fault injection: nan_loss poisoning "
-                                 f"iteration {self.iteration + 1}")
+                    if self._prefetcher is None:
+                        # prefetched batches were poisoned by the worker's
+                        # transform (same iteration numbering); the sync
+                        # path poisons here
+                        batch = resilience.host_batch_faults(
+                            batch, self.iteration + 1, self.log)
                     # forward + backward + optimizer are ONE fused jit
                     # region here (the reference's separate spans,
                     # training.py:500-525, would break that fusion);
                     # --profile gives the op-level breakdown instead
                     compile_snap = (self.telemetry.compile_snapshot()
                                     if self.telemetry is not None else None)
-                    self.timers("forward-backward-optimizer", 0).start()
-                    metrics = self.train_step(batch)
-                    loss_host = float(metrics["loss"])  # host sync
-                    self.timers("forward-backward-optimizer", 0).stop()
-                    ntok = batch.get("tokens",
-                                     next(iter(batch.values()))).size
-                    if self.telemetry is not None:
-                        step_s = self.timers.last_s(
-                            "forward-backward-optimizer")
-                        self.telemetry.step(
-                            self.iteration, step_s, ntok,
-                            self.telemetry.recompiles.delta(compile_snap),
-                            loss=loss_host,
-                            lr=float(metrics["lr"]),
-                            grad_norm=float(metrics["grad_norm"]),
-                            skipped=bool(float(metrics.get("skipped", 0.0))),
-                            data_wait_ms=round(self.timers.last_s(
-                                "batch-generator") * 1e3, 3),
-                            tokens_per_s=round(ntok / max(step_s, 1e-9), 1),
-                            model_tflops_per_s=round(
-                                ntok / max(step_s, 1e-9)
-                                * model_flops_per_token / 1e12, 3))
-                        self.telemetry.heartbeat(
-                            f"iteration {self.iteration}")
+                    tm = self.timers("forward-backward-optimizer", 0)
+                    tm.start()
+                    if self._prefetcher is not None:
+                        metrics = self.train_step_placed(batch)
+                    else:
+                        metrics = self.train_step(batch)
+                    # lag 0 pays the host sync inside the span (the
+                    # synchronous loop's behavior: the span measures the
+                    # full device step); lag K defers it to _process_record
+                    host = self._fetch_metrics(metrics) if lag == 0 else None
+                    tm.stop()
+                    ntok = int(batch.get(
+                        "tokens", next(iter(batch.values()))).size)
+                    pending.append({
+                        "iteration": self.iteration, "gbs": gbs,
+                        "consumed": self.consumed_samples, "ntok": ntok,
+                        "metrics": metrics, "host": host,
+                        "dispatch_s": self.timers.last_s(
+                            "forward-backward-optimizer"),
+                        "data_wait_s": data_wait_s,
+                        "compile_delta": (
+                            self.telemetry.recompiles.delta(compile_snap)
+                            if self.telemetry is not None else None)})
 
-                    if self._sentinel is not None:
-                        streak = metrics.get("skip_streak")
-                        step_skipped = bool(float(metrics.get("skipped", 0.0)))
-                        trip = self._sentinel.observe(
-                            loss_host, step_skipped,
-                            streak=(int(float(streak)) if streak is not None
-                                    else None))
-                        if trip is None and not step_skipped:
-                            self._healthy_steps += 1
-                            if (self._rollbacks
-                                    and self.iteration > self._skip_data_until
-                                    and self._healthy_steps
-                                    >= self._rollback_reset_after):
-                                self.log(
-                                    f"sentinel: {self._healthy_steps} healthy"
-                                    " steps since the last rollback —"
-                                    " restoring the rollback budget")
-                                self._rollbacks = 0
-                        else:
-                            self._healthy_steps = 0
-                        if trip and self._handle_divergence(trip):
-                            # rolled back: rebuild the data iterator at the
-                            # rewound consumed_samples and discard the
-                            # contaminated logging window
-                            data_iter = None
-                            current_gbs = None
-                            window_tokens, window_t0 = 0, time.time()
-                            loss_avg, loss_n = 0.0, 0
-                            self.timers.elapsed_ms(reset=True)
-                            continue
-
-                    window_tokens += ntok
-                    loss_avg += loss_host
-                    loss_n += 1
-
-                if self.iteration % t.log_interval == 0 and loss_n == 0:
-                    # window had only skipped iterations: still close it
-                    # (discard timer accumulation too, or the next window's
-                    # per-iteration averages count two windows of elapsed)
-                    self.log(f"iteration {self.iteration}/{t.train_iters} | "
-                             f"consumed samples: {self.consumed_samples} | "
-                             "all iterations in window skipped")
-                    self.timers.elapsed_ms(reset=True)
-                    window_tokens, window_t0 = 0, time.time()
-                if self.iteration % t.log_interval == 0 and loss_n > 0:
-                    dt = time.time() - window_t0
-                    tps = window_tokens / max(dt, 1e-9)
-                    mfu_flops = tps * model_flops_per_token
-                    self.log(
-                        f"iteration {self.iteration}/{t.train_iters} | "
-                        f"consumed samples: {self.consumed_samples} | "
-                        f"lm loss: {loss_avg / max(loss_n, 1):.6f} | "
-                        f"lr: {float(metrics['lr']):.3e} | "
-                        f"grad norm: {float(metrics['grad_norm']):.3f} | "
-                        f"skipped: {int(metrics['skipped'])} | "
-                        f"tokens/sec: {tps:,.0f} | "
-                        f"model TFLOP/s: {mfu_flops / 1e12:.1f}")
-                    self.writer.add_scalar("train/lm_loss",
-                                           loss_avg / max(loss_n, 1),
-                                           self.iteration)
-                    self.writer.add_scalar("train/lr", float(metrics["lr"]),
-                                           self.iteration)
-                    self.writer.add_scalar("train/grad_norm",
-                                           float(metrics["grad_norm"]),
-                                           self.iteration)
-                    self.writer.add_scalar("train/tokens_per_sec", tps,
-                                           self.iteration)
-                    if "num_zeros" in metrics:
-                        self.writer.add_scalar(
-                            "train/num_zeros", float(metrics["num_zeros"]),
-                            self.iteration)
-                    if t.log_batch_size:
-                        self.writer.add_scalar("train/global_batch_size",
-                                               gbs, self.iteration)
-                    if t.log_world_size:
-                        self.writer.add_scalar("train/world_size",
-                                               jax.device_count(),
-                                               self.iteration)
-                    if t.log_params_norm:
-                        self.writer.add_scalar("train/params_norm",
-                                               self._params_norm(),
-                                               self.iteration)
-                    if t.log_memory:
-                        for k, v in self._memory_stats().items():
-                            self.writer.add_scalar(f"memory/{k}", v,
-                                                   self.iteration)
-                    # per-span wall clock, averaged per iteration over the
-                    # window (ref: timers.log / --log_timers_to_tensorboard,
-                    # megatron/timers.py:79-96)
-                    if t.log_timers_to_tensorboard:
-                        for name, ms in self.timers.elapsed_ms(
-                                reset=False).items():
-                            self.writer.add_scalar(
-                                f"timers/{name}", ms / max(loss_n, 1),
-                                self.iteration)
-                    ts = self.timers.log_string(normalizer=max(loss_n, 1))
-                    if ts:
-                        self.log(ts)
-                    if self.telemetry is not None:
-                        self.telemetry.emit(
-                            "goodput", iteration=self.iteration,
-                            **self.telemetry.goodput_report())
-                    self.writer.flush()
-                    window_tokens, window_t0 = 0, time.time()
-                    loss_avg, loss_n = 0.0, 0
+                if drain(lag):
+                    on_rollback()
+                    continue
 
                 if (valid_iter_factory and t.eval_interval
                         and self.iteration % t.eval_interval == 0):
+                    # eval is a pipeline sync point anyway: drain so the
+                    # sentinel's verdicts precede it (a trip cancels it)
+                    if drain(0):
+                        on_rollback()
+                        continue
                     self.timers("eval-time", 0).start()
                     ev = self.evaluate(valid_iter_factory(), t.eval_iters)
                     self.timers("eval-time", 0).stop()
@@ -838,6 +1068,13 @@ class TrainLoop:
                 saved_now = bool(
                     t.save_interval and self.iteration % t.save_interval == 0)
                 if saved_now or should_exit:
+                    # never checkpoint past un-judged metrics: drain so a
+                    # sentinel trip still in the pipeline CANCELS the save
+                    # (this closes the lag-widened window where a diverged
+                    # state could be committed and then rolled back onto)
+                    if drain(0):
+                        on_rollback()
+                        continue
                     self.save()
                     if self.telemetry is not None:
                         self.telemetry.heartbeat(

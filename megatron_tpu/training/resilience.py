@@ -145,6 +145,22 @@ def poison_batch(batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
     return out
 
 
+def host_batch_faults(batch: Dict[str, np.ndarray], iteration: int,
+                      log=None) -> Dict[str, np.ndarray]:
+    """Apply the host-side batch faults armed for `iteration` (currently
+    nan_loss poisoning); identity otherwise. The ONE hook both loop modes
+    share: the synchronous loop calls it right before placement, the async
+    loop's prefetcher calls it as its per-batch transform (with the
+    iteration each batch will be consumed at), so an injected fault
+    poisons exactly the same batches either way and the two loops stay
+    bitwise-comparable under faults (tests/test_prefetch.py)."""
+    if fault_active("nan_loss", iteration):
+        if log is not None:
+            log(f"fault injection: nan_loss poisoning iteration {iteration}")
+        return poison_batch(batch)
+    return batch
+
+
 class DivergenceSentinel:
     """Host-side divergence watchdog over per-step (loss, skipped) pairs.
 
@@ -159,6 +175,15 @@ class DivergenceSentinel:
 
     observe() returns None while healthy, or a human-readable trip reason.
     Either detector is disabled by setting its knob to 0.
+
+    Async-loop lag: with --metrics_lag K the train loop feeds observe()
+    each step's metrics K steps after dispatch, so a trip DECISION lands K
+    steps late — but it still names the step that tripped, the loop rolls
+    back with that step as the poison-window bound, and the <=K newer
+    in-flight steps are discarded wholesale by the checkpoint restore. Net
+    effect: trip *latency* grows by K (bounded, documented in
+    docs/fault_tolerance.md); the post-rollback trajectory is identical to
+    the synchronous loop's.
     """
 
     def __init__(self, patience: int = 100, spike_factor: float = 0.0,

@@ -71,7 +71,19 @@ class _DummyTimer:
 
 class Timers:
     """timers('span', level)(start/stop); below-threshold spans are no-ops
-    (ref: Timers with --timing_log_level)."""
+    (ref: Timers with --timing_log_level).
+
+    Span truthfulness: a start/stop pair measures host wall-clock only, so
+    a span around an async dispatch (device_put, jitted call) measures the
+    DISPATCH, not the work. Spans that must cover the work either sync
+    inside the span (the train loop's `batch-transfer` span holds a
+    block_until_ready; `forward-backward-optimizer` holds the metrics
+    host-fetch in the synchronous loop) or are split into an honest
+    dispatch span plus a landed/completion span credited via record() from
+    wherever the completion is actually observed (the async loop's
+    prefetcher measures transfer time on its worker thread and the loop
+    credits it at pop time; the lagged metrics fetch is recorded as
+    `metrics-fetch`)."""
 
     def __init__(self, log_level: int = 0):
         self.log_level = log_level
@@ -84,6 +96,22 @@ class Timers:
         if name not in self._timers:
             self._timers[name] = _Timer(name)
         return self._timers[name]
+
+    def record(self, name: str, seconds: float, level: int = 0) -> None:
+        """Credit an externally measured duration as one completed span of
+        `name` (level-gated like __call__). For spans whose wall-clock is
+        observed somewhere a start/stop pair cannot reach: another thread
+        (the prefetcher's device transfers) or a pipelined completion (the
+        async loop's lagged metrics fetch). Must be called from the loop
+        thread — _Timer is not thread-safe."""
+        if level > self.log_level or seconds < 0:
+            return
+        if name not in self._timers:
+            self._timers[name] = _Timer(name)
+        t = self._timers[name]
+        t._last = seconds
+        t._elapsed += seconds
+        t._count += 1
 
     def elapsed_ms(self, names=None, reset: bool = True) -> Dict[str, float]:
         """{span: accumulated ms since last reset} (for writer scalars)."""

@@ -22,6 +22,17 @@ from megatron_tpu.platform import force_cpu  # noqa: E402
 if os.environ.get("MEGATRON_TPU_TEST_PLATFORM", "cpu") == "cpu":
     force_cpu(8)
 
+# Persistent-compilation-cache hygiene (PR 4): the suite must run with the
+# cache DISABLED in-process. Historically bench.main() (first compiling
+# module, alphabetically early) latched the process onto .jax_cache for
+# every later module by accident; re-creating that deliberately turned out
+# to be unsafe on this jax/XLA:CPU — a process that WRITES a cache entry
+# and later deserializes-and-executes its own entry (a fresh jit of the
+# same HLO, e.g. a second TrainLoop at the same geometry) crashes with
+# SIGSEGV/SIGABRT inside the execute, reproducibly. bench.async_loop_bench
+# therefore reset_cache()s on exit, and the cold/warm cache tests run in
+# subprocesses (tests/test_prefetch.py).
+
 
 def pytest_configure(config):
     config.addinivalue_line(

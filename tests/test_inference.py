@@ -172,6 +172,8 @@ def test_tokenize_prompts_padding():
     assert batch[1, 1] == tok.pad
 
 
+@pytest.mark.slow  # 11s measured cacheless (PR 4 tier-1 re-budget);
+# test_beam_search_beats_greedy_logprob keeps beam coverage in tier-1
 def test_beam_search_kv_cache_matches_full_reforward():
     """The cached incremental beam decode must produce the same beams as a
     brute-force full-re-forward implementation (the pre-KV-cache behavior)."""
@@ -341,6 +343,8 @@ def test_server_http_roundtrip_sharded_pipelined():
         server.shutdown()
 
 
+@pytest.mark.slow  # 13s measured cacheless (PR 4 tier-1 re-budget);
+# generation/teacher-forcing parity keeps inference coverage in tier-1
 def test_zeroshot_wikitext_adjusted_ppl(tmp_path):
     """--task wikitext reports word-level adjusted perplexity with the
     reference's token-ratio normalization (zeroshot_gpt/evaluate.py)."""

@@ -4,6 +4,7 @@ optimization — cache bytes halve at bounded logit drift)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from megatron_tpu.models import presets
 from megatron_tpu.models.language_model import lm_forward
@@ -48,6 +49,8 @@ def test_int8_cache_halves_kv_bytes():
     assert scales * CFG.head_dim == payload * 4
 
 
+@pytest.mark.slow  # 12s measured cacheless (PR 4 tier-1 re-budget);
+# the quantize/dequant unit parity tests keep kv-int8 coverage in tier-1
 def test_cached_decode_with_int8_matches_full_forward():
     """Decode token-by-token with the int8 cache; logits must track the
     uncached full forward within quantization tolerance and agree on

@@ -202,6 +202,8 @@ def test_moe_zero1_state_specs_valid():
     jax.block_until_ready(state.params)
 
 
+@pytest.mark.slow  # 14s measured cacheless (PR 4 tier-1 re-budget);
+# the dropless exact/overflow cases keep dispatch coverage in tier-1
 def test_moe_dropless_matches_capacity_at_ample_capacity():
     """With capacity that admits every choice, the capacity path drops
     nothing — so the dropless sort/ragged_dot path must produce the SAME
@@ -266,6 +268,8 @@ def test_moe_dropless_keeps_overflow_tokens():
                                rtol=2e-5, atol=2e-6)
 
 
+@pytest.mark.slow  # 13s measured cacheless (PR 4 tier-1 re-budget);
+# the overflow/EP dropless cases keep dispatch coverage in tier-1
 def test_moe_dropless_exact_under_data_sharding():
     """dropless at dp=8 (GSPMD auto-sharding of the sort/scatter) must be
     numerically identical to the single-device path — loss AND grads."""
@@ -522,6 +526,8 @@ def test_moe_dropless_serves_single_row_on_ep_mesh():
                                rtol=2e-5, atol=2e-6)
 
 
+@pytest.mark.slow  # 8s measured cacheless (PR 4 tier-1 re-budget);
+# the EP dispatch/overflow cases keep expert-axis coverage in tier-1
 def test_moe_dropless_trains_with_expert_axis():
     """The r4 refusal is gone: dropless + ep2 runs a full TrainLoop step
     (the ep path inside the fused train step, ZeRO-1 on)."""

@@ -102,6 +102,8 @@ def _batch(samples, n, num_neg):
             for k in items[0]}
 
 
+@pytest.mark.slow  # 24s measured cacheless (PR 4 tier-1 re-budget);
+# test_orqa_eval_invariant_to_tail_padding keeps orqa coverage in tier-1
 def test_orqa_loss_grads_and_neg_candidates():
     samples = [dict(question=r["question"].rstrip("?"),
                     pos_context=r["positive_ctxs"][0],

@@ -93,6 +93,8 @@ def test_preprocess_and_train_and_resume(tmp_path):
     assert loop2.iteration == 16
 
 
+@pytest.mark.slow  # 20s subprocess measured cacheless (PR 4 re-budget);
+# the in-process preprocess->train->resume e2e above stays tier-1
 def test_pretrain_gpt_cli(tmp_path):
     """Drive the actual CLI entry point as a subprocess (CPU mesh)."""
     jsonl = _make_corpus(tmp_path, n_docs=120)

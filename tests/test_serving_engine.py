@@ -190,6 +190,8 @@ def test_engine_greedy_parity_with_eod():
     np.testing.assert_array_equal(got.tokens[0, :2], want.tokens[0, :2])
 
 
+@pytest.mark.slow  # 11s measured cacheless (PR 4 tier-1 re-budget);
+# greedy/int8/ragged parity tests keep engine coverage in tier-1
 def test_interleaved_traffic_parity():
     """A request's tokens must not change when other slots are active —
     greedy AND sampled (per-slot PRNG chains)."""
@@ -305,6 +307,8 @@ def test_attention_kv_lengths_matches_causal_suffix():
         np.testing.assert_allclose(got[b:b + 1], want, atol=1e-6)
 
 
+@pytest.mark.slow  # 10s measured cacheless (PR 4 tier-1 re-budget);
+# greedy/int8 parity keeps sampler coverage in tier-1
 def test_sample_logits_batched_matches_scalar_semantics():
     logits = jnp.asarray([[1.0, 5.0, 2.0, 0.0], [0.0, -1.0, 3.0, 1.0]])
     keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(2, dtype=jnp.uint32))
@@ -357,6 +361,8 @@ def test_sample_logits_batched_matches_scalar_semantics():
 # HTTP serving through the engine
 
 
+@pytest.mark.slow  # 21s measured cacheless (PR 4 tier-1 re-budget);
+# engine parity + HTTP roundtrip tests keep serving coverage in tier-1
 def test_server_engine_concurrent_requests():
     """Concurrent HTTP requests share the engine's decode ticks and each
     gets the same greedy output as the one-shot service."""

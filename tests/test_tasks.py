@@ -7,6 +7,7 @@ import json
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from megatron_tpu.models.classification import (
     classification_config, classification_forward, classification_loss,
@@ -78,6 +79,8 @@ def _mnli_tsv(path, n, vocab=90, rng=None):
             f.write("\t".join(row) + "\n")
 
 
+@pytest.mark.slow  # 20s measured cacheless (PR 4 tier-1 re-budget);
+# the RACE harness end-to-end keeps task-harness coverage in tier-1
 def test_glue_mnli_harness_end_to_end(tmp_path):
     """tasks.main on toy MNLI: runs, logs accuracy, learns the signal."""
     from tasks import main as tasks_main
@@ -109,6 +112,8 @@ def test_glue_mnli_harness_end_to_end(tmp_path):
     assert acc > 0.5  # learnable toy signal beats 1/3 chance
 
 
+@pytest.mark.slow  # 9s measured cacheless (PR 4 tier-1 re-budget);
+# classification/multichoice units keep task coverage in tier-1
 def test_race_harness_end_to_end(tmp_path):
     """tasks.main on toy RACE: multiple-choice path runs end to end."""
     from tasks import main as tasks_main

@@ -404,6 +404,8 @@ def corpus(tmp_path_factory):
     return prefix
 
 
+@pytest.mark.slow  # 27s subprocess run measured cacheless (PR 4
+# re-budget); the in-process goodput/journal units above stay tier-1
 def test_train_goodput_attributes_slow_save_stall(tmp_path, corpus):
     """Acceptance: a faulted (slow_save) training run's journal shows the
     checkpoint stall attributed to non-productive time. --no_async_save

@@ -130,6 +130,20 @@ def summarize(events: List[Dict[str, Any]], top_n: int = 5) -> Dict[str, Any]:
             out["last_loss"] = round(losses[-1], 6)
         compiles = sum(int(e.get("compiles", 0)) for e in steps)
         out["step_compiles"] = compiles
+        # warm-start evidence: persistent-compilation-cache hits recorded
+        # on step records (a resumed run pays retrieval, not XLA)
+        cache_hits = sum(int(e.get("cache_hits", 0)) for e in steps)
+        if cache_hits:
+            out["compile_cache_hits"] = cache_hits
+        # async-loop health: steady-state queue-pop wait should be ~0 —
+        # a growing p50 here means the input pipeline can no longer hide
+        # behind the device step (docs/performance.md "Async goodput loop")
+        waits = sorted(float(e["data_wait_ms"]) for e in steps
+                       if "data_wait_ms" in e)
+        if waits:
+            out["data_wait_ms"] = {"p50": round(percentile(waits, 0.50), 3),
+                                   "p99": round(percentile(waits, 0.99), 3),
+                                   "max": round(waits[-1], 3)}
     return out
 
 
@@ -156,6 +170,14 @@ def render(summary: Dict[str, Any]) -> str:
     if "tokens_per_s" in summary:
         t = summary["tokens_per_s"]
         lines.append(f"tokens/s: p50 {t['p50']} | max {t['max']}")
+    if "data_wait_ms" in summary:
+        w = summary["data_wait_ms"]
+        lines.append(f"data wait ms: p50 {w['p50']} | p99 {w['p99']} | "
+                     f"max {w['max']}")
+    if summary.get("compile_cache_hits"):
+        lines.append(
+            f"compile cache hits: {summary['compile_cache_hits']} "
+            "(warm persistent cache)")
     if summary.get("last_loss") is not None:
         lines.append(f"last loss: {summary['last_loss']}")
     if summary.get("faults"):
