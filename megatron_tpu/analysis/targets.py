@@ -1,0 +1,228 @@
+"""Audit targets: the repo's real jitted programs, traced on CPU.
+
+Each builder returns an :class:`AuditTarget` whose ``jaxpr()`` /
+``lowered()`` / ``compiled_text()`` feed the jaxpr auditor, the
+donation audit, and the HLO collective counter. Everything runs on the
+8-device fake CPU mesh (tests/conftest.py) — no chip needed; geometry
+is pinned tiny so contract manifests stay byte-stable.
+
+The train-step targets build a real TrainLoop (the same construction
+tier-1's parallel-matrix tests exercise) so the audited program IS the
+production step — pipeline schedule, ZeRO-1 placement, donation and
+all — not a lookalike.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megatron_tpu.config import (
+    ModelConfig, OptimizerConfig, ParallelConfig, RunConfig, TrainingConfig,
+)
+
+
+@dataclasses.dataclass
+class AuditTarget:
+    """A traceable program plus the arguments to trace it with."""
+
+    name: str
+    fn: Callable                 # already-jitted or plain callable
+    args: tuple                  # ShapeDtypeStructs (sharded where needed)
+    mesh: Optional[Any] = None   # entered (set_mesh) around trace/lower
+    can_compile: bool = True     # False: old-XLA paths that CHECK-crash
+
+    def _scope(self):
+        import contextlib
+
+        return (jax.sharding.set_mesh(self.mesh) if self.mesh is not None
+                else contextlib.nullcontext())
+
+    def jaxpr(self):
+        with self._scope():
+            return jax.make_jaxpr(lambda *a: self.fn(*a))(*self.args)
+
+    def lowered(self):
+        fn = self.fn
+        if not hasattr(fn, "lower"):
+            fn = jax.jit(fn)
+        with self._scope():
+            return fn.lower(*self.args)
+
+    def compiled_text(self) -> str:
+        if not self.can_compile:
+            raise RuntimeError(
+                f"{self.name}: compiling this target CHECK-crashes the "
+                "baked XLA (see compat.py); jaxpr-level audit only")
+        with self._scope():
+            return self.lowered().compile().as_text()
+
+
+def tiny_model(**overrides) -> ModelConfig:
+    """The pinned contract geometry (matches the parallel-matrix tests)."""
+    kw: Dict[str, Any] = dict(
+        num_layers=4, hidden_size=32, num_attention_heads=4, num_kv_heads=2,
+        ffn_hidden_size=64, vocab_size=128, seq_length=32,
+        params_dtype="float32")
+    kw.update(overrides)
+    return ModelConfig(**kw).validate()
+
+
+def _sds(tree):
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), tree)
+
+
+# ---------------------------------------------------------------------------
+# train step
+# ---------------------------------------------------------------------------
+
+
+def train_step_target(name: str, parallel_kwargs: Dict[str, Any],
+                      zero1: bool = False,
+                      model_overrides: Optional[Dict[str, Any]] = None,
+                      global_batch: int = 8) -> AuditTarget:
+    """The production train step: a real TrainLoop's jitted step lowered
+    on ShapeDtypeStructs (state donated, batch sharded like _put_batch)."""
+    from megatron_tpu.training.pretrain import TrainLoop
+
+    cfg = RunConfig(
+        model=tiny_model(**(model_overrides or {})),
+        parallel=ParallelConfig(**parallel_kwargs),
+        optimizer=OptimizerConfig(lr=1e-3, lr_decay_style="constant",
+                                  use_distributed_optimizer=zero1),
+        training=TrainingConfig(micro_batch_size=1,
+                                global_batch_size=global_batch,
+                                train_iters=2, log_interval=1,
+                                recompute_granularity="full"))
+    loop = TrainLoop(cfg, log=lambda s: None)
+    n_micro = max(global_batch // (1 * loop.rt.dp), 1)
+    step = loop._train_step_for(n_micro)
+    seq = cfg.model.seq_length
+    batch = {
+        "tokens": jax.ShapeDtypeStruct((global_batch, seq), jnp.int64,
+                                       sharding=loop.batch_sharding),
+        "labels": jax.ShapeDtypeStruct((global_batch, seq), jnp.int64,
+                                       sharding=loop.batch_sharding),
+        "loss_mask": jax.ShapeDtypeStruct((global_batch, seq), jnp.float32,
+                                          sharding=loop.batch_sharding),
+    }
+    state = jax.tree.map(
+        lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+        loop.state, loop.state_shardings)
+    return AuditTarget(name=name, fn=step, args=(state, batch),
+                       mesh=loop.rt.mesh)
+
+
+# ---------------------------------------------------------------------------
+# engine decode step
+# ---------------------------------------------------------------------------
+
+
+def decode_step_target(name: str = "decode_step",
+                       dtype: str = "bfloat16",
+                       num_slots: int = 4) -> AuditTarget:
+    """The serving engine's batched decode step. Donation is forced on
+    (the TPU configuration) so the audit checks the shipped intent even
+    though XLA:CPU would ignore it at execution time."""
+    from megatron_tpu.inference.engine import InferenceEngine
+    from megatron_tpu.models.params import init_params
+
+    cfg = tiny_model(params_dtype=dtype)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    eng = InferenceEngine(cfg, params, num_slots=num_slots,
+                          max_seq_len=cfg.seq_length, force_donate=True)
+    N = num_slots
+    args = (
+        _sds(params),
+        _sds(eng.caches),
+        jax.ShapeDtypeStruct((N,), jnp.int32),      # last_tok
+        jax.ShapeDtypeStruct((N,), jnp.int32),      # lengths
+        jax.ShapeDtypeStruct((N, 2), jnp.uint32),   # keys
+        jax.ShapeDtypeStruct((N,), jnp.float32),    # temps
+        jax.ShapeDtypeStruct((N,), jnp.int32),      # top_ks
+        jax.ShapeDtypeStruct((N,), jnp.float32),    # top_ps
+    )
+    return AuditTarget(name=name, fn=eng._decode_step, args=args)
+
+
+# ---------------------------------------------------------------------------
+# op-level bodies: ring / ulysses / moe
+# ---------------------------------------------------------------------------
+
+
+def _context_mesh(cp: int = 2):
+    from megatron_tpu.parallel.mesh import build_mesh
+
+    return build_mesh(ParallelConfig(context_parallel=cp)).mesh
+
+
+def ring_attention_target(name: str = "ring_cp2", cp: int = 2,
+                          with_grad: bool = True) -> AuditTarget:
+    """Zig-zag causal ring attention (einsum inner: the CPU-provable
+    path) + its backward — K/V rotate cp times fwd, grads add two more
+    ppermute streams bwd."""
+    from megatron_tpu.ops.ring_attention import ring_attention_sharded
+
+    mesh = _context_mesh(cp)
+    B, S, Hq, Hkv, D = 2, 32, 4, 2, 8
+    q = jax.ShapeDtypeStruct((B, S, Hq, D), jnp.float32)
+    kv = jax.ShapeDtypeStruct((B, S, Hkv, D), jnp.float32)
+
+    def fwd(q, k, v):
+        return ring_attention_sharded(q, k, v, mesh, mask_type="causal",
+                                      inner_impl="einsum")
+
+    fn = (lambda q, k, v: jax.grad(
+        lambda q, k, v: fwd(q, k, v).astype(jnp.float32).sum(),
+        argnums=(0, 1, 2))(q, k, v)) if with_grad else fwd
+    return AuditTarget(name=name, fn=fn, args=(q, kv, kv), mesh=mesh)
+
+
+def ulysses_attention_target(name: str = "ulysses_cp2",
+                             cp: int = 2,
+                             with_grad: bool = True) -> AuditTarget:
+    """Ulysses all-to-all attention: 3 scatter-heads + 1 inverse
+    all-to-all forward; the backward mirrors them."""
+    from megatron_tpu.ops.ulysses import ulysses_attention_sharded
+
+    mesh = _context_mesh(cp)
+    B, S, Hq, Hkv, D = 2, 32, 4, 2, 8
+    q = jax.ShapeDtypeStruct((B, S, Hq, D), jnp.float32)
+    kv = jax.ShapeDtypeStruct((B, S, Hkv, D), jnp.float32)
+
+    def fwd(q, k, v):
+        return ulysses_attention_sharded(q, k, v, mesh, inner_impl="xla")
+
+    fn = (lambda q, k, v: jax.grad(
+        lambda q, k, v: fwd(q, k, v).astype(jnp.float32).sum(),
+        argnums=(0, 1, 2))(q, k, v)) if with_grad else fwd
+    return AuditTarget(name=name, fn=fn, args=(q, kv, kv), mesh=mesh)
+
+
+def moe_block_target(name: str = "moe_ep2", ep: int = 2) -> AuditTarget:
+    """Dropless expert-parallel MoE dispatch (CPU transport: all_gather
+    reconstruction). jaxpr-only: compiling the shard_map output back
+    into GSPMD context RET_CHECK-crashes this XLA's sharding remover
+    (compat.py / memory notes), so can_compile=False."""
+    from megatron_tpu.parallel.mesh import build_mesh
+    from megatron_tpu.ops.moe import moe_block
+
+    mesh = build_mesh(ParallelConfig(expert_parallel=ep)).mesh
+    from megatron_tpu.ops.activations import mlp_input_width_factor
+
+    cfg = tiny_model(num_experts=4, moe_top_k=2, moe_dispatch="dropless")
+    H, F, E = cfg.hidden_size, cfg.ffn_size, cfg.num_experts
+    Fin = F * mlp_input_width_factor(cfg.activation)
+    p = {
+        "router": jax.ShapeDtypeStruct((H, E), jnp.float32),
+        "w_in": jax.ShapeDtypeStruct((E, H, Fin), jnp.float32),
+        "w_out": jax.ShapeDtypeStruct((E, F, H), jnp.float32),
+    }
+    x = jax.ShapeDtypeStruct((4, cfg.seq_length, H), jnp.float32)
+    return AuditTarget(name=name, fn=lambda p, x: moe_block(cfg, p, x),
+                       args=(p, x), mesh=mesh, can_compile=False)

@@ -49,7 +49,9 @@ def native_helpers() -> Optional[object]:
         _native_tried = True
         try:
             _native = _build_native()
-        except Exception as e:  # no compiler, bad env — fall back to numpy
+        except Exception as e:  # noqa: BLE001 - no compiler, bad env,
+            # cffi quirks: anything here means "no native build" — fall
+            # back to the numpy reference implementations (warned)
             warnings.warn(f"native dataset helpers unavailable ({e}); "
                           "using slower Python fallbacks")
             _native = None

@@ -139,7 +139,9 @@ def build_data_loader(
                 if not _put(collate([dataset[i] for i in idx_batch])):
                     return
             _put(_END)
-        except BaseException as e:  # surfaced to the consumer
+        except BaseException as e:  # noqa: BLE001 - worker thread: every
+            # failure (incl. KeyboardInterrupt) must surface on the
+            # consuming thread, not die silently here
             _put(e)
 
     t = threading.Thread(target=worker, daemon=True)

@@ -92,9 +92,14 @@ class InferenceEngine:
                  vocab_size: Optional[int] = None, mesh=None,
                  want_logprobs: bool = True,
                  metrics: Optional[MetricsRegistry] = None,
-                 flight_recorder=None):
+                 flight_recorder=None,
+                 force_donate: Optional[bool] = None):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
+        # force_donate: override the backend-derived donation choice
+        # (None = donate except on XLA:CPU). The jaxpr/donation auditor
+        # sets True so CPU-traced audits check the TPU-shipped intent.
+        self.force_donate = force_donate
         self.cfg = cfg
         self.params = params
         self.num_slots = num_slots
@@ -184,6 +189,8 @@ class InferenceEngine:
         # donate the persistent cache so each step updates it in place
         # (the whole point of a slot cache); XLA:CPU can't donate and
         # would warn every compile
+        if self.force_donate is not None:
+            return (1,) if self.force_donate else ()
         return (1,) if jax.default_backend() != "cpu" else ()
 
     def _commit(self, tree):

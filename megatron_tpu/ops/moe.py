@@ -264,6 +264,8 @@ def _ragged_exchange(rows, out_len, in_off, send, out_off, recv,
     @jax.custom_vjp
     def ex(r, i_off, s, o_off, rv, bm):
         out = jnp.zeros((out_len, r.shape[-1]), r.dtype)
+        # jaxlint: disable=banned-api - TPU-only path gated behind
+        # _use_ragged_transport(); CPU/CI takes _dense_exchange
         return jax.lax.ragged_all_to_all(
             r, out, i_off.astype(jnp.int32), s.astype(jnp.int32),
             o_off.astype(jnp.int32), rv.astype(jnp.int32),
@@ -276,6 +278,8 @@ def _ragged_exchange(rows, out_len, in_off, send, out_off, recv,
         n_in, bm = res
         b_in_off, b_send, b_out_off, b_recv = bm
         gout = jnp.zeros((n_in, g.shape[-1]), g.dtype)
+        # jaxlint: disable=banned-api - mirrored exchange of the gated
+        # TPU-only forward above; CPU/CI never traces this VJP
         gr = jax.lax.ragged_all_to_all(
             g, gout, b_in_off.astype(jnp.int32), b_send.astype(jnp.int32),
             b_out_off.astype(jnp.int32), b_recv.astype(jnp.int32),

@@ -72,6 +72,8 @@ def _bound_axis_names():
     i.e. the MANUAL axes at this trace point. Private-API probe (no public
     accessor on jax 0.4.37); fail-soft to 'none bound'."""
     try:
+        # jaxlint: disable=internal-api - no public accessor on jax
+        # 0.4.37; any drift lands in the except => 'none bound'
         from jax._src import core as _core
 
         return set(_core.unsafe_get_axis_names())

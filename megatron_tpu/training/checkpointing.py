@@ -528,6 +528,8 @@ def _ambient_mesh():
     None. get_concrete_mesh is in jax._src (no public accessor for the
     concrete — not abstract — ambient mesh as of jax 0.9), so fail soft."""
     try:
+        # jaxlint: disable=internal-api - no public concrete-mesh
+        # accessor; drift lands in the except below with a loud warning
         from jax._src import mesh as mesh_lib
 
         mesh = mesh_lib.get_concrete_mesh()

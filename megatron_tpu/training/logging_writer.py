@@ -20,19 +20,31 @@ class Writer:
         if tensorboard_dir:
             try:
                 from torch.utils.tensorboard import SummaryWriter
-
-                self._tb = SummaryWriter(log_dir=tensorboard_dir)
-            except Exception as e:  # tensorboard not installed
+            except ImportError as e:
                 print(f"tensorboard unavailable ({e}); scalars not written")
+            else:
+                try:
+                    self._tb = SummaryWriter(log_dir=tensorboard_dir)
+                except Exception as e:  # noqa: BLE001 - unwritable dir is
+                    # OSError but version-skewed protobuf/tensorboard raise
+                    # their own types; an optional logger must never kill
+                    # the training run
+                    print(f"tensorboard unavailable ({e}); "
+                          "scalars not written")
         if wandb:
             try:
                 import wandb as wandb_mod
-
-                self._wandb = wandb_mod
-                wandb_mod.init(project=wandb_project, name=wandb_name,
-                               config=config or {})
-            except Exception as e:
+            except ImportError as e:
                 print(f"wandb unavailable ({e}); scalars not written")
+            else:
+                try:
+                    wandb_mod.init(project=wandb_project, name=wandb_name,
+                                   config=config or {})
+                    self._wandb = wandb_mod
+                except Exception as e:  # noqa: BLE001 - third-party init
+                    # (network, auth, server) raises wandb-internal types;
+                    # an optional logger must never kill the training run
+                    print(f"wandb unavailable ({e}); scalars not written")
 
     def add_scalar(self, tag: str, value: float, step: int) -> None:
         if self._tb is not None:

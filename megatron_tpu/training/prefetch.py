@@ -116,7 +116,9 @@ class DevicePrefetcher:
                 if not self._enqueue(device_batch):
                     return
             self._enqueue(self._end)
-        except BaseException as e:  # surfaced on the consuming thread
+        except BaseException as e:  # noqa: BLE001 - worker thread: every
+            # failure (incl. KeyboardInterrupt) must surface on the
+            # consuming thread, not die silently here
             self._enqueue(e)
 
     # -- consumer side -------------------------------------------------------

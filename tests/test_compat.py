@@ -1,0 +1,160 @@
+"""compat.py shim branches: what the shims actually allow, pinned.
+
+The jaxlint banned-API rules (megatron_tpu/analysis/ast_lint.py) encode
+what this toolchain can't run; these tests keep the two in sync — if a
+jax upgrade makes a shim a no-op, the linter tests here say which rules
+can be retired (ISSUE 6 satellite).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from megatron_tpu import compat
+from megatron_tpu.analysis import ast_lint
+from megatron_tpu.config import ParallelConfig
+from megatron_tpu.parallel.mesh import ambient_mesh_shape, build_mesh
+
+
+def _mesh(cp=2):
+    return build_mesh(ParallelConfig(context_parallel=cp)).mesh
+
+
+def test_install_is_idempotent():
+    """Every entry point imports the package (and so installs) — a
+    second install must not stack wrappers or flip behavior."""
+    before = (jax.shard_map, jax.lax.axis_size,
+              jax.sharding.get_abstract_mesh, compat.SHARD_MAP_SHIMMED)
+    compat.install()
+    after = (jax.shard_map, jax.lax.axis_size,
+             jax.sharding.get_abstract_mesh, compat.SHARD_MAP_SHIMMED)
+    assert before == after
+
+
+def test_axis_size_inside_shard_map():
+    mesh = _mesh(cp=2)
+    got = {}
+
+    def body(x):
+        got["one"] = jax.lax.axis_size("context")
+        return x
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(P("context"),),
+                      out_specs=P("context"), check_vma=False)
+    fn(jnp.zeros((4, 4)))
+    assert got["one"] == 2
+
+
+def test_axis_size_tuple_and_unbound():
+    """The shim multiplies tuple axes and raises NameError on unbound
+    names (both branches of compat._install_axis_size)."""
+    mesh = _mesh(cp=2)
+    got = {}
+
+    def body(x):
+        got["pair"] = jax.lax.axis_size(("data", "context"))
+        with pytest.raises(NameError):
+            jax.lax.axis_size("no-such-axis")
+        return x
+
+    fn = jax.shard_map(body, mesh=mesh,
+                      in_specs=(P(("data", "context")),),
+                      out_specs=P(("data", "context")), check_vma=False)
+    fn(jnp.zeros((8, 4)))
+    # full-manual (shim) binds all axes: data=4 x context=2. On a jax
+    # whose shard_map honors axis_names, only context would be bound —
+    # the test would catch that semantic shift too.
+    assert got["pair"] == 8 if compat.SHARD_MAP_SHIMMED else got["pair"] >= 2
+
+
+def test_abstract_mesh_normalizes_to_none():
+    """jax 0.4.37 returns an empty TUPLE when no mesh is set; the shim
+    normalizes to None so `mesh is None or not mesh.shape` guards work."""
+    m = jax.sharding.get_abstract_mesh()
+    assert m is None or hasattr(m, "shape")
+    assert ambient_mesh_shape() == {}
+
+
+def test_set_mesh_publishes_to_all_accessors():
+    mesh = _mesh(cp=2)
+    with jax.sharding.set_mesh(mesh):
+        am = jax.sharding.get_abstract_mesh()
+        assert am is not None and dict(am.shape)["context"] == 2
+        assert ambient_mesh_shape()["context"] == 2
+        # legacy thread_resources path: bare-PartitionSpec constraints
+        # inside jit must resolve against the ambient mesh
+        out = jax.jit(lambda x: jax.lax.with_sharding_constraint(
+            x, P("context")))(jnp.zeros((4, 4)))
+        assert out.shape == (4, 4)
+    assert ambient_mesh_shape() == {}
+
+
+def test_shard_map_shim_full_manual_semantics():
+    """The shim ignores axis_names (promotes ALL axes to manual): an
+    axis OUTSIDE axis_names is still bound inside the body. That is the
+    documented numerically-equivalent degradation — if it changes (jax
+    upgrade making partial-auto real), SHARD_MAP_SHIMMED must be False
+    and the skip-gated kernel tests come back."""
+    if not compat.SHARD_MAP_SHIMMED:
+        pytest.skip("native jax.shard_map: partial-auto is real here")
+    mesh = _mesh(cp=2)
+    got = {}
+
+    def body(x):
+        # "data" was NOT in axis_names; full-manual still binds it
+        got["data"] = jax.lax.axis_size("data")
+        return jax.lax.psum(x, "context")
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(P("context"),),
+                      out_specs=P("context"), axis_names={"context"},
+                      check_vma=False)
+    out = fn(jnp.ones((4, 4)))
+    assert got["data"] == 4
+    np.testing.assert_allclose(np.asarray(out), 2.0 * np.ones((4, 4)))
+
+
+def test_shard_map_shim_flag_matches_reality():
+    native = jax.shard_map.__module__.startswith("jax._src") and \
+        not hasattr(jax.shard_map, "__wrapped__")
+    assert compat.SHARD_MAP_SHIMMED == (not native)
+
+
+# ---------------------------------------------------------------------------
+# linter <-> shim sync
+# ---------------------------------------------------------------------------
+
+
+def test_linter_bans_what_the_toolchain_lacks():
+    """On the shimmed toolchain, ragged_all_to_all / partial-auto
+    shard_map / direct experimental imports must be linter-banned; the
+    moe transport probe must agree (CPU: dense exchange)."""
+    snippet = (
+        "import jax\n"
+        "from jax.experimental.shard_map import shard_map\n"
+        "def f(x):\n"
+        "    y = jax.lax.ragged_all_to_all(x, x, x, x, x, x,"
+        " axis_name='ep')\n"
+        "    return jax.shard_map(lambda a: a, mesh=None, in_specs=(),"
+        " out_specs=(), auto=frozenset({'data'}))\n"
+    )
+    findings = ast_lint.lint_source(snippet, "snippet.py")
+    msgs = "\n".join(f.message for f in findings)
+    assert "ragged_all_to_all" in msgs
+    assert "jax.experimental.shard_map" in msgs
+    assert "partial-auto" in msgs
+
+    if compat.SHARD_MAP_SHIMMED:
+        from megatron_tpu.ops.moe import _use_ragged_transport
+
+        # retire the banned-api lint rule when this starts failing: the
+        # toolchain grew a ragged_all_to_all the CPU transport probe accepts
+        assert jax.default_backend() != "cpu" or not _use_ragged_transport()
+
+
+def test_linter_rules_registry_complete():
+    """Every rule the docs promise exists and is enforced by default."""
+    assert set(ast_lint.RULES) == {
+        "host-sync", "banned-api", "internal-api", "broad-except",
+        "traced-branch"}

@@ -43,7 +43,8 @@ def run_one(micro_bs, granularity, seq_length=2048, iters=5,
     state, step, batch = build_step(cfg, micro_bs, granularity)
     try:
         dt, _, state = time_step(state, step, batch, iters=iters)
-    except Exception as e:
+    except Exception as e:  # noqa: BLE001 - OOM probe: classify-and-keep
+        # only resource exhaustion; anything else re-raises below
         if is_oom(e):
             return {"micro_bs": micro_bs, "recompute": granularity,
                     "oom": True}

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""comm_report: print (or regenerate) the golden comm-contract tables.
+
+Reads the checked-in manifests in megatron_tpu/analysis/golden/ and
+prints the per-config collective count/bytes ledger — the static
+communication budget of every audited parallel config. This is the
+operational face of the comm contracts (docs/static_analysis.md): run
+it before/after a parallelism change to see what moved.
+
+Usage:
+    python tools/comm_report.py                    # table from golden
+    python tools/comm_report.py --config train_pp2 # one config
+    python tools/comm_report.py --check            # rebuild + diff (slow)
+    python tools/comm_report.py --regen [name ...] # retrace + rewrite JSON
+
+Printing golden needs no jax; --check/--regen trace (and partly
+compile) the real programs on the fake CPU mesh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+_REPO = Path(__file__).resolve().parent.parent
+GOLDEN_DIR = _REPO / "megatron_tpu" / "analysis" / "golden"
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n}B"
+        n /= 1024
+    return f"{n}B"
+
+
+def _print_manifest(name: str, manifest: dict) -> None:
+    j = manifest.get("jaxpr", {})
+    colls = j.get("collectives", {})
+    hlo = manifest.get("hlo", {}).get("collectives", {})
+    print(f"\n== {name} "
+          f"(jax {manifest.get('toolchain', {}).get('jax', '?')}) ==")
+    print(f"  host_callbacks={j.get('host_callbacks', '?')} "
+          f"scalar_carries_in_shard_map="
+          f"{j.get('scalar_carries_in_shard_map', '?')} "
+          f"manual_axis_constraints={j.get('manual_axis_constraints', '?')}")
+    if colls:
+        w = max(len(k) for k in colls)
+        print(f"  {'jaxpr collective':<{w}}  {'count':>6} "
+              f"{'bytes/call':>10} {'total':>10}")
+        for key, v in colls.items():
+            print(f"  {key:<{w}}  {v['count']:>6} "
+                  f"{_fmt_bytes(v['bytes_per_call']):>10} "
+                  f"{_fmt_bytes(v['total_bytes']):>10}")
+        print(f"  {'TOTAL':<{w}}  {'':>6} {'':>10} "
+              f"{_fmt_bytes(j.get('total_collective_bytes', 0)):>10}")
+    else:
+        print("  jaxpr collectives: none (contract: stays that way)")
+    if hlo:
+        print("  hlo (post-GSPMD, static op counts):")
+        for op, v in hlo.items():
+            print(f"    {op:<20} count={v['count']:>4} "
+                  f"bytes={_fmt_bytes(v['total_bytes'])}")
+    elif "hlo" in manifest:
+        print("  hlo collectives: none")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--config", action="append", default=None,
+                    help="limit to these config names (repeatable)")
+    ap.add_argument("--check", action="store_true",
+                    help="rebuild each manifest and diff against golden")
+    ap.add_argument("--regen", nargs="*", metavar="NAME", default=None,
+                    help="retrace and REWRITE golden manifests "
+                    "(all when no names given)")
+    args = ap.parse_args(argv)
+
+    if args.check and args.regen is not None:
+        ap.error("--check and --regen are mutually exclusive")
+    if args.regen is not None or args.check:
+        sys.path.insert(0, str(_REPO))
+        import megatron_tpu  # noqa: F401 - installs compat shims
+        from megatron_tpu.analysis import contracts
+
+        names = args.regen or args.config or sorted(contracts.CONFIGS)
+        if args.check:
+            problems = []
+            for name in names:
+                problems += contracts.check_contract(name, level="all")
+            for p in problems:
+                print(p)
+            print("comm contracts:", "OK" if not problems else
+                  f"{len(problems)} mismatch(es)")
+            return 1 if problems else 0
+        for name in names:
+            path = contracts.write_manifest(name)
+            print(f"wrote {path}")
+        return 0
+
+    names = args.config or sorted(
+        p.stem for p in GOLDEN_DIR.glob("*.json"))
+    if not names:
+        print(f"no golden manifests in {GOLDEN_DIR} — generate with "
+              "--regen", file=sys.stderr)
+        return 1
+    for name in names:
+        path = GOLDEN_DIR / f"{name}.json"
+        if not path.exists():
+            print(f"{name}: no golden manifest at {path}", file=sys.stderr)
+            return 1
+        _print_manifest(name, json.loads(path.read_text()))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

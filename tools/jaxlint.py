@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""jaxlint: the repo's tracing-discipline AST linter (CLI).
+
+Runs megatron_tpu/analysis/ast_lint.py over source trees and exits
+non-zero when findings survive the allowlists. Loads the rules module
+by file path, so this never imports jax (or megatron_tpu) — safe for
+pre-commit hooks and cold CI shards.
+
+Usage:
+    python tools/jaxlint.py                  # lint megatron_tpu/ (default)
+    python tools/jaxlint.py path/ file.py    # explicit targets
+    python tools/jaxlint.py --rules broad-except,host-sync
+    python tools/jaxlint.py --list-rules
+    python tools/jaxlint.py --format json
+
+Rules and the allowlist format are documented in docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import sys
+from pathlib import Path
+
+_REPO = Path(__file__).resolve().parent.parent
+_RULES_PATH = _REPO / "megatron_tpu" / "analysis" / "ast_lint.py"
+
+
+def _load_ast_lint():
+    spec = importlib.util.spec_from_file_location("_jaxlint_rules",
+                                                  _RULES_PATH)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod  # dataclasses resolves string annotations
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*",
+                    default=[str(_REPO / "megatron_tpu")],
+                    help="files or directories (default: megatron_tpu/)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated subset of rules to run")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    args = ap.parse_args(argv)
+
+    lint = _load_ast_lint()
+    if args.list_rules:
+        for name, desc in sorted(lint.RULES.items()):
+            print(f"{name:15s} {desc}")
+        return 0
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in rules if r not in lint.RULES]
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)} "
+                  f"(known: {', '.join(sorted(lint.RULES))})",
+                  file=sys.stderr)
+            return 2
+    findings = lint.lint_paths(args.paths, rules=rules)
+    if args.format == "json":
+        print(json.dumps([f.__dict__ for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f)
+        if findings:
+            print(f"\njaxlint: {len(findings)} finding(s) — fix or "
+                  "allowlist with '# jaxlint: disable=<rule> - reason'",
+                  file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
