@@ -449,6 +449,104 @@ def serving_engine_bench(deadline, num_slots=4, prompt_len=8, new_tokens=24):
     return line
 
 
+def serve_prefix_cache_bench(deadline, num_requests=8, shared_len=64,
+                             unique_len=8, new_tokens=4):
+    """Shared-system-prompt traffic through the paged engine
+    (inference/paging/): every request is a shared `shared_len`-token
+    system prefix plus a distinct `unique_len`-token user suffix — the
+    "millions of users, one prompt template" shape. The first request
+    populates the radix prefix cache; the rest alias its pages and skip
+    prefill for the shared span. value = total prompt tokens / prefill
+    tokens actually computed (deterministic — read off the engine's
+    counters, not wall clocks); vs_baseline is the wall-time speedup of
+    the same traffic vs the slot engine, which recomputes every prefix."""
+    line = {"metric": "serve_prefix_cache_speedup", "value": 0.0,
+            "unit": "x_prefill_tokens", "vs_baseline": 0.0}
+    if deadline - time.perf_counter() < 30:
+        line["error"] = "budget_exhausted"
+        return line
+    try:
+        import jax
+
+        from megatron_tpu.inference.engine import InferenceEngine, Request
+        from megatron_tpu.inference.paging import PagedInferenceEngine
+        from megatron_tpu.models import presets
+        from megatron_tpu.models.params import init_params
+
+        cfg = headline_config()
+        if jax.default_backend() == "cpu" and cfg.hidden_size > 512:
+            # CPU runs are recipe/sanity runs (docs/serving.md): shrink to
+            # a llama-shaped model that finishes in seconds
+            cfg = presets.tiny(
+                vocab_size=8192, seq_length=256, hidden_size=256,
+                num_layers=4, num_attention_heads=8, num_kv_heads=8,
+                ffn_hidden_size=512, params_dtype="float32")
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        rng = np.random.default_rng(0)
+        shared = rng.integers(1, cfg.vocab_size, shared_len)
+        prompts = [np.concatenate([
+            shared, rng.integers(1, cfg.vocab_size, unique_len),
+        ]).astype(np.int32) for _ in range(num_requests)]
+
+        def drive(eng):
+            # first request alone (populates the prefix cache), then the
+            # rest concurrently — the arrival pattern a warm template sees
+            t0 = time.perf_counter()
+            r0 = eng.submit(Request(prompt=prompts[0],
+                                    max_new_tokens=new_tokens))
+            eng.run_until_idle()
+            rest = [eng.submit(Request(prompt=p, max_new_tokens=new_tokens))
+                    for p in prompts[1:]]
+            eng.run_until_idle()
+            for r in [r0] + rest:
+                if r.error:
+                    raise RuntimeError(r.error)
+            return time.perf_counter() - t0
+
+        # page-aligned so neither engine warns about seq-len rounding
+        max_len = -(-(shared_len + unique_len + new_tokens + 16) // 16) * 16
+        paged = PagedInferenceEngine(cfg, params, num_slots=4,
+                                     max_seq_len=max_len,
+                                     page_size=16, prefill_chunk=32,
+                                     want_logprobs=False)
+        drive(paged)  # warmup: compiles chunk + decode steps
+        # drop the warmup's radix entries so the measured drive IS the
+        # documented cold-template scenario (r0 populates, the rest
+        # alias) — without this every request including r0 hits the
+        # warm cache and `value` overstates the cold-traffic savings
+        paged.prefix_cache.clear()
+        warm_computed = paged.stats["prefill_tokens"]
+        warm_hits = paged.stats["prefix_hits"]
+        t_paged = drive(paged)
+        computed = paged.stats["prefill_tokens"] - warm_computed
+
+        slot = InferenceEngine(cfg, params, num_slots=4,
+                               max_seq_len=max_len,
+                               want_logprobs=False)
+        drive(slot)  # warmup
+        t_slot = drive(slot)
+
+        total_prompt = num_requests * (shared_len + unique_len)
+        line.update(
+            value=round(total_prompt / max(computed, 1), 3),
+            vs_baseline=round(t_slot / max(t_paged, 1e-9), 3),
+            detail={
+                "num_requests": num_requests, "shared_len": shared_len,
+                "unique_len": unique_len,
+                "prefill_tokens_computed": int(computed),
+                "prefill_tokens_total": int(total_prompt),
+                "prefix_hits": int(paged.stats["prefix_hits"] - warm_hits),
+                "paged_wall_s": round(t_paged, 4),
+                "slot_wall_s": round(t_slot, 4),
+                "decode_recompiles_after_warmup": int(
+                    paged.stats["decode_recompiles"]),
+                "hidden": cfg.hidden_size, "layers": cfg.num_layers,
+            })
+    except Exception as e:  # noqa: BLE001 - the metric line must emit
+        line["error"] = str(e)[:300]
+    return line
+
+
 def async_loop_bench(deadline, stall_ms=20.0, iters=14, skip_gaps=2):
     """Async-goodput-loop micro-bench (ISSUE 5 acceptance; CPU-able): a
     tiny TrainLoop is fed an iterator with an injected stall_ms host stall
@@ -696,9 +794,10 @@ def main():
             print(f"# compilation cache unavailable: {e}", file=sys.stderr)
 
     if os.environ.get("MEGATRON_TPU_BENCH_SERVING_ONLY"):
-        # local recipe (docs/serving.md): just the serving metric, skip
+        # local recipe (docs/serving.md): just the serving metrics, skip
         # the multi-minute training-step search. Never set by the driver.
         print(json.dumps(serving_engine_bench(deadline)), flush=True)
+        print(json.dumps(serve_prefix_cache_bench(deadline)), flush=True)
         return
 
     from megatron_tpu.models.params import num_params
@@ -824,11 +923,13 @@ def main():
     # cost the round its number
     try:
         if not quick:
-            # serving metric rides as its own JSON line BEFORE the headline
-            # (and before any extras lines — the only positional contract
-            # is that the headline MFU line comes LAST for the driver;
-            # consumers of the serving metric must match on "metric")
+            # serving metrics ride as their own JSON lines BEFORE the
+            # headline (and before any extras lines — the only positional
+            # contract is that the headline MFU line comes LAST for the
+            # driver; consumers of serving metrics must match on "metric")
             print(json.dumps(serving_engine_bench(deadline)), flush=True)
+            print(json.dumps(serve_prefix_cache_bench(deadline)),
+                  flush=True)
         if want_extras:
             run_extras(deadline, peak, extras)
 

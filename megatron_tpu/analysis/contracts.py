@@ -76,6 +76,12 @@ CONFIGS: Dict[str, Callable[[], Any]] = {
     # callbacks" — a hidden all_gather in serving fails here
     "decode_single": lambda: _targets().decode_step_target(
         "decode_single"),
+    # paged engine decode step (page-table KV gather): same zero-
+    # collective / zero-callback / full-donation contract as
+    # decode_single, pinned separately because the gather + scatter
+    # indexing is a whole new code path (inference/paging/)
+    "decode_paged": lambda: _targets().paged_decode_step_target(
+        "decode_paged"),
 }
 
 

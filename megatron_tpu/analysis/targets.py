@@ -150,6 +150,36 @@ def decode_step_target(name: str = "decode_step",
     return AuditTarget(name=name, fn=eng._decode_step, args=args)
 
 
+def paged_decode_step_target(name: str = "decode_paged",
+                             dtype: str = "bfloat16",
+                             num_slots: int = 4) -> AuditTarget:
+    """The paged serving engine's batched decode step (page-table KV
+    gather + per-slot lengths). Same contract as decode_single: ZERO
+    collectives, zero host callbacks, full cache donation — a hidden
+    all_gather or callback in the paged path fails here."""
+    from megatron_tpu.inference.paging import PagedInferenceEngine
+    from megatron_tpu.models.params import init_params
+
+    cfg = tiny_model(params_dtype=dtype)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    eng = PagedInferenceEngine(cfg, params, num_slots=num_slots,
+                               max_seq_len=cfg.seq_length, page_size=8,
+                               prefill_chunk=16, force_donate=True)
+    N = num_slots
+    args = (
+        _sds(params),
+        _sds(eng.caches),
+        jax.ShapeDtypeStruct((N, eng.max_pages), jnp.int32),  # page table
+        jax.ShapeDtypeStruct((N,), jnp.int32),      # last_tok
+        jax.ShapeDtypeStruct((N,), jnp.int32),      # lengths
+        jax.ShapeDtypeStruct((N, 2), jnp.uint32),   # keys
+        jax.ShapeDtypeStruct((N,), jnp.float32),    # temps
+        jax.ShapeDtypeStruct((N,), jnp.int32),      # top_ks
+        jax.ShapeDtypeStruct((N,), jnp.float32),    # top_ps
+    )
+    return AuditTarget(name=name, fn=eng._decode_step, args=args)
+
+
 # ---------------------------------------------------------------------------
 # op-level bodies: ring / ulysses / moe
 # ---------------------------------------------------------------------------

@@ -43,6 +43,17 @@ from megatron_tpu.inference.generation import GenerationOutput, _init_caches
 from megatron_tpu.inference.sampling import sample_logits_batched
 from megatron_tpu.telemetry.metrics import MetricsRegistry, default_registry
 
+#: flash_decode (ops/pallas/flash_decode.py) requires the cache length
+#: divisible by this; engines round max_seq_len UP to it on the TPU
+#: kernel path so the fused kernel is never silently lost to the dense
+#: fallback (the _pick_block -> ValueError -> dispatcher chain).
+KERNEL_SEQ_MULTIPLE = 128
+
+
+class EngineOverloadedError(RuntimeError):
+    """The engine's admission queue is at max_queue: the request was
+    rejected, not queued. HTTP serving maps this to 503 + Retry-After."""
+
 
 @dataclasses.dataclass
 class Request:
@@ -57,6 +68,12 @@ class Request:
     # engine-filled
     generated: List[int] = dataclasses.field(default_factory=list)
     logprobs: List[float] = dataclasses.field(default_factory=list)
+    # preemption/resume (paged engine): the PRNG chain state at
+    # preemption, so a recompute-resumed request samples the exact
+    # tokens it would have sampled without the preemption
+    resume_key: Optional[np.ndarray] = None
+    # queue-overload rejection marker (submit with max_queue exceeded)
+    overloaded: bool = False
     # teacher-forced logprobs of prompt[1:] from the admission prefill
     # (the one-shot path returns these too; generation.py:136-141)
     prompt_logprobs: List[float] = dataclasses.field(default_factory=list)
@@ -93,9 +110,12 @@ class InferenceEngine:
                  want_logprobs: bool = True,
                  metrics: Optional[MetricsRegistry] = None,
                  flight_recorder=None,
-                 force_donate: Optional[bool] = None):
+                 force_donate: Optional[bool] = None,
+                 max_queue: Optional[int] = None):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
+        if max_queue is not None and max_queue < 1:
+            raise ValueError("max_queue must be >= 1 (or None: unbounded)")
         # force_donate: override the backend-derived donation choice
         # (None = donate except on XLA:CPU). The jaxpr/donation auditor
         # sets True so CPU-traced audits check the TPU-shipped intent.
@@ -103,7 +123,9 @@ class InferenceEngine:
         self.cfg = cfg
         self.params = params
         self.num_slots = num_slots
-        self.max_seq_len = int(max_seq_len or cfg.seq_length)
+        self.max_queue = max_queue
+        self.max_seq_len = self._round_seq_len(
+            int(max_seq_len or cfg.seq_length))
         if (cfg.position_embedding_type == "absolute"
                 and self.max_seq_len > (cfg.max_position_embeddings or 0)):
             raise ValueError(
@@ -116,8 +138,7 @@ class InferenceEngine:
         self.want_logprobs = want_logprobs
 
         N = num_slots
-        self.caches = self._commit(
-            _init_caches(cfg, N, self.max_seq_len, int8=kv_cache_int8))
+        self.caches = self._commit(self._fresh_caches())
         self.slots: List[Optional[Request]] = [None] * N
         self.lengths = np.zeros(N, np.int32)    # valid context per slot
         self.last_tok = np.zeros(N, np.int32)   # sampled, not yet in cache
@@ -164,7 +185,7 @@ class InferenceEngine:
                                     "requests completed")
         self._m_rejected = m.counter("engine_requests_rejected_total",
                                      "requests rejected (invalid/oversized/"
-                                     "failed prefill)")
+                                     "failed prefill/queue full)")
         self._m_ticks = m.counter("engine_ticks_total",
                                   "batched decode steps executed")
         self._m_tokens = m.counter("engine_tokens_generated_total",
@@ -182,6 +203,41 @@ class InferenceEngine:
         self._m_tick = m.histogram("engine_decode_tick_seconds",
                                    "batched decode tick wall time")
         self._m_slots.set(num_slots)
+
+    # ----- cache + shape policy -------------------------------------------
+
+    def _kernel_seq_multiple(self) -> int:
+        """Cache-length divisibility the TPU decode kernel needs. The
+        dense flash-decode kernel rejects caches not divisible by 128
+        (_pick_block -> ValueError) and the dispatcher then SILENTLY
+        falls back to the masked-einsum path — so engines round up
+        instead of quietly losing the kernel. 1 = no constraint (CPU
+        hosts interpret the kernel; the paged engine's grid is per-page
+        and overrides this)."""
+        if (self.cfg.attention_impl == "pallas"
+                and jax.default_backend() != "cpu"):
+            return KERNEL_SEQ_MULTIPLE
+        return 1
+
+    def _round_seq_len(self, n: int) -> int:
+        m = self._kernel_seq_multiple()
+        if m <= 1 or n % m == 0:
+            return n
+        rounded = -(-n // m) * m
+        import warnings
+
+        warnings.warn(
+            f"engine max_seq_len {n} is not a multiple of {m}; rounding "
+            f"up to {rounded} so the fused flash-decode kernel stays "
+            "usable (a non-divisible cache would silently run the dense "
+            "fallback every tick)", stacklevel=3)
+        return rounded
+
+    def _fresh_caches(self):
+        """Host-built zeroed KV storage (overridden by the paged engine
+        to build page pools instead of per-slot rows)."""
+        return _init_caches(self.cfg, self.num_slots, self.max_seq_len,
+                            int8=self.kv_cache_int8)
 
     # ----- jitted device steps --------------------------------------------
 
@@ -311,6 +367,17 @@ class InferenceEngine:
             self._m_rejected.inc()
             return req
         with self._cv:
+            if (self.max_queue is not None
+                    and len(self._queue) >= self.max_queue):
+                # bounded admission: overload degrades to fast rejection
+                # (HTTP 503 upstream) instead of unbounded queue latency
+                req.overloaded = True
+                req._finish(
+                    f"engine queue full ({self.max_queue} waiting); "
+                    "retry later")
+                self.stats["rejected"] += 1
+                self._m_rejected.inc()
+                return req
             self._queue.append(req)
             self._m_queue.set(len(self._queue))
             self._cv.notify_all()
@@ -402,10 +469,7 @@ class InferenceEngine:
                         if other is not None:
                             self._clear_slot(j)
                             other._finish(f"prefill failed: {e}")
-                    self.caches = self._commit(
-                        _init_caches(self.cfg, self.num_slots,
-                                     self.max_seq_len,
-                                     int8=self.kv_cache_int8))
+                    self.caches = self._commit(self._fresh_caches())
                     self._m_active.set(self.num_active)
                 continue
             self.caches = caches
@@ -445,9 +509,25 @@ class InferenceEngine:
         for every active slot. Returns the number of active slots served
         (0 = idle)."""
         self._admit()
-        if self.num_active == 0:
+        return self._decode_tick()
+
+    def _decode_rows(self):
+        """Slot indices the batched decode serves this tick (the paged
+        engine excludes slots still mid-chunked-prefill)."""
+        return [i for i, s in enumerate(self.slots) if s is not None]
+
+    def _decode_extra_args(self):
+        """Extra positional args spliced between caches and the carry in
+        the decode-step call (the paged engine passes its device page
+        table here)."""
+        return ()
+
+    def _decode_tick(self) -> int:
+        """One batched decode for every decodable slot; returns how many
+        were served (0 = nothing to decode)."""
+        active = self._decode_rows()
+        if not active:
             return 0
-        active = [i for i, s in enumerate(self.slots) if s is not None]
         if self._carry is None:
             self._carry = self._commit(
                 (jnp.asarray(self.last_tok),
@@ -460,8 +540,8 @@ class InferenceEngine:
         t_tick = time.monotonic()
         try:
             toks, lps, caches, keys, lens = self._decode_step(
-                self.params, self.caches, last, lens, keys, temps, top_ks,
-                top_ps)
+                self.params, self.caches, *self._decode_extra_args(),
+                last, lens, keys, temps, top_ks, top_ps)
         except Exception as e:  # noqa: BLE001 - fail the in-flight
             # requests (their waiters must unblock) and restore a usable
             # cache (donation may have consumed the old buffers), then
@@ -472,9 +552,7 @@ class InferenceEngine:
                 req._finish(f"decode step failed: {e}")
             self._m_active.set(self.num_active)
             self._carry = None
-            self.caches = self._commit(
-                _init_caches(self.cfg, self.num_slots, self.max_seq_len,
-                             int8=self.kv_cache_int8))
+            self.caches = self._commit(self._fresh_caches())
             raise
         self.caches = caches
         # toks/lens/keys chain into the next tick on device; only the
@@ -551,17 +629,38 @@ class InferenceEngine:
         changes a response."""
         B, maxp = prompts.shape
         reqs = []
-        for b in range(B):
-            p = int(lengths[b])
-            reqs.append(self.submit(Request(
-                prompt=np.asarray(prompts[b, :p], np.int32),
-                max_new_tokens=maxp - p + max_new_tokens,
-                temperature=temperature,
-                top_k=top_k, top_p=top_p, eod=eod, seed=seed + b)))
+        # the queue-capacity check and the B submits happen under ONE
+        # lock acquisition (the Condition lock is an RLock, so submit()
+        # re-entering it is fine): a batch that can't fully queue is
+        # rejected BEFORE submitting anything — otherwise the admitted
+        # rows would decode to completion only to have their output
+        # discarded when the rejected row raises below, burning decode
+        # capacity exactly when the engine is overloaded. Checking and
+        # submitting under separate acquisitions would let two
+        # concurrent batches both pass the check and then trip the
+        # per-row rejection mid-submission anyway.
+        with self._cv:
+            if (self.max_queue is not None
+                    and len(self._queue) + B > self.max_queue):
+                self.stats["rejected"] += B
+                self._m_rejected.inc(B)
+                raise EngineOverloadedError(
+                    f"engine queue cannot take {B} more requests "
+                    f"(max_queue={self.max_queue}); retry later")
+            for b in range(B):
+                p = int(lengths[b])
+                reqs.append(self.submit(Request(
+                    prompt=np.asarray(prompts[b, :p], np.int32),
+                    max_new_tokens=maxp - p + max_new_tokens,
+                    temperature=temperature,
+                    top_k=top_k, top_p=top_p, eod=eod, seed=seed + b)))
         if self._thread is None:
             self.run_until_idle()
         for r in reqs:
             r.done.wait()
+        if any(r.overloaded for r in reqs):
+            raise EngineOverloadedError(
+                next(r.error for r in reqs if r.overloaded))
         errs = [r.error for r in reqs if r.error]
         if errs:
             raise ValueError(errs[0])

@@ -1,0 +1,43 @@
+"""Paged KV-cache serving: block pool + radix prefix cache + chunked
+prefill.
+
+The slot engine (inference/engine.py) reserves `max_seq_len` cache rows
+per slot up front — a young sequence in a long cache wastes almost all
+of them, and two requests sharing a system prompt each recompute and
+store it. This package replaces the per-slot reservation with a shared
+pool of fixed-size pages:
+
+  * :mod:`pool` — the free-list page allocator with refcounts. KV
+    storage becomes ``[layers, num_pages, page_size, kv_heads, head_dim]``
+    and each slot holds an int32 page table mapping logical blocks to
+    physical pages.
+  * :mod:`radix` — a radix tree over token IDs at page granularity:
+    requests sharing a prompt prefix map their tables onto the same
+    refcounted pages and skip prefill for the shared span (copy-on-write
+    when a partially-shared page is about to be written).
+  * :mod:`scheduler` — the chunked-prefill queue: long prompts enter the
+    cache `prefill_chunk` tokens per engine tick, interleaved with the
+    batched decode, so one long prompt can never stall the batch.
+  * :mod:`engine` — :class:`PagedInferenceEngine`, the drop-in paged
+    mode of the serving engine (``--serve_kv_paging``). Token-identical
+    to the slot engine on the serving test matrix
+    (tests/test_serving_engine.py), zero decode recompiles after warmup.
+
+The decode attention path reads through the table: the paged
+flash-decode kernel (ops/pallas/paged_flash_decode.py) resolves pages
+inside the Pallas grid on TPU; everywhere else ops/attention.py gathers
+the pages into a dense view and the masked einsum computes identical
+values.
+"""
+
+from megatron_tpu.inference.paging.engine import PagedInferenceEngine
+from megatron_tpu.inference.paging.pool import PagePool
+from megatron_tpu.inference.paging.radix import RadixPrefixCache
+from megatron_tpu.inference.paging.scheduler import ChunkedPrefillQueue
+
+__all__ = [
+    "PagedInferenceEngine",
+    "PagePool",
+    "RadixPrefixCache",
+    "ChunkedPrefillQueue",
+]

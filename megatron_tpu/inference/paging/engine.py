@@ -1,0 +1,550 @@
+"""PagedInferenceEngine: the serving engine over a shared page pool.
+
+Drop-in paged mode of the slot engine (inference/engine.py,
+``--serve_kv_paging``). The per-slot ``[N, max_seq_len, ...]`` cache rows
+become one pool of fixed-size pages shared by every slot:
+
+  * admission allocates pages for the PROMPT span only (a young sequence
+    holds the pages it has, not its worst case); decode grows a slot one
+    page at a time as its length crosses page boundaries;
+  * requests sharing a prompt prefix alias the same refcounted pages via
+    the radix tree (radix.py) and skip prefill for the shared span;
+  * prompts enter the cache ``prefill_chunk`` tokens per tick, one chunk
+    before each batched decode (scheduler.py), so one long prompt can
+    never stall the whole batch;
+  * under memory pressure the engine first evicts cache-only prefix
+    pages (LRU), then preempts the lowest-priority slot — the most
+    recently admitted request (LIFO, so later arrivals yield to earlier
+    ones). A preempted request keeps its sampled tokens and PRNG chain
+    (Request.resume_key) and resumes by teacher-forced recompute of
+    prompt + generated, which is exact: it finishes with the tokens it
+    would have produced without the preemption.
+
+Parity gates (tests/test_serving_engine.py): token-identical to the slot
+engine on the serving matrix — greedy, sampled, int8, ragged, preempted
+— and zero decode recompiles after warmup (the decode step's shapes,
+including the ``[N, max_pages]`` device page table, never change).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megatron_tpu.config import ModelConfig
+from megatron_tpu.inference.engine import InferenceEngine, Request
+from megatron_tpu.inference.paging.pool import SCRATCH_PAGE, PagePool
+from megatron_tpu.inference.paging.radix import RadixPrefixCache
+from megatron_tpu.inference.paging.scheduler import (
+    ChunkedPrefillQueue, PrefillTask,
+)
+from megatron_tpu.inference.sampling import sample_logits_batched
+
+
+class PagedInferenceEngine(InferenceEngine):
+    """Slot scheduler + paged KV pool + radix prefix cache.
+
+    Same threading contract as the base engine: submit() from any
+    thread, step()/run_until_idle() from one driver thread.
+    """
+
+    def __init__(self, cfg: ModelConfig, params: Any, num_slots: int = 8,
+                 max_seq_len: Optional[int] = None,
+                 kv_cache_int8: bool = False,
+                 page_size: int = 16, prefill_chunk: int = 32,
+                 num_pages: Optional[int] = None,
+                 vocab_size: Optional[int] = None, mesh=None,
+                 want_logprobs: bool = True, metrics=None,
+                 flight_recorder=None,
+                 force_donate: Optional[bool] = None,
+                 max_queue: Optional[int] = None):
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        if num_pages is not None and num_pages < 2:
+            raise ValueError(
+                f"num_pages must be >= 2 (page 0 is scratch), got {num_pages}")
+        self.page_size = int(page_size)
+        self.prefill_chunk = int(prefill_chunk)  # validated by the queue
+        self.num_pages = num_pages
+        self.max_pages = 0          # set by _fresh_caches (needs max_seq_len)
+        self.prefix_cache: Optional[RadixPrefixCache] = None
+        super().__init__(
+            cfg, params, num_slots=num_slots, max_seq_len=max_seq_len,
+            kv_cache_int8=kv_cache_int8, vocab_size=vocab_size, mesh=mesh,
+            want_logprobs=want_logprobs, metrics=metrics,
+            flight_recorder=flight_recorder, force_donate=force_donate,
+            max_queue=max_queue)
+        if self.num_pages - 1 < self.max_pages:
+            raise ValueError(
+                f"num_pages={self.num_pages} cannot hold even one full "
+                f"sequence ({self.max_pages} pages of {self.page_size} for "
+                f"max_seq_len {self.max_seq_len}, + the scratch page)")
+
+        N = num_slots
+        self.pool = PagePool(self.num_pages)
+        self.prefix_cache = RadixPrefixCache(self.pool, self.page_size)
+        # host page tables: tables[i] is slot i's logical->physical map.
+        # Mid-prefill slots keep their REAL row in _pending_rows and a
+        # scratch row here, so the shared decode table can never route an
+        # idle-drift write into a half-filled (possibly shared) page.
+        self.tables = np.zeros((N, self.max_pages), np.int32)
+        self._pending_rows = {}
+        self._device_table = None
+        self._table_dirty = True
+        self.prefill_queue = ChunkedPrefillQueue(self.prefill_chunk)
+        self._chunk_step = self._build_chunk_step()
+        # admission order for the preemption policy (higher = younger)
+        self._admit_seq = [0] * N
+        self._admit_counter = 0
+
+        self.stats.update({
+            "prefix_hits": 0, "prefix_misses": 0,
+            "prefix_tokens_saved": 0, "prefill_tokens": 0,
+            "prefill_chunks": 0, "preemptions": 0,
+        })
+        m = self.metrics
+        self._m_pages_total = m.gauge("engine_pages_total",
+                                      "KV pool pages (minus scratch)")
+        self._m_pages_free = m.gauge("engine_pages_free",
+                                     "KV pool pages on the free list")
+        self._m_prefix_hits = m.counter(
+            "engine_prefix_cache_hits_total",
+            "admissions that aliased cached prefix pages")
+        self._m_prefix_misses = m.counter(
+            "engine_prefix_cache_misses_total",
+            "admissions with no cached prefix")
+        self._m_prefix_saved = m.counter(
+            "engine_prefix_tokens_saved_total",
+            "prefill positions skipped via the prefix cache")
+        self._m_preempted = m.counter(
+            "engine_preemptions_total",
+            "slots preempted under page-pool pressure")
+        self._m_chunks = m.counter("engine_prefill_chunks_total",
+                                   "chunked-prefill steps executed")
+        self._m_chunk = m.histogram("engine_prefill_chunk_seconds",
+                                    "one prefill chunk's wall time")
+        self._m_pages_total.set(self.num_pages - 1)
+        self._m_pages_free.set(self.pool.free_pages)
+
+    # ----- cache + shape policy -------------------------------------------
+
+    def _kernel_seq_multiple(self) -> int:
+        # logical capacity is whole pages; the paged kernel's grid is
+        # per-page, so the dense kernel's 128 constraint doesn't apply
+        return self.page_size
+
+    def _fresh_caches(self):
+        """Paged pools [L, num_pages, page_size, kv_heads, head_dim]
+        (int8: the 4-tuple with per-position scales). On the
+        failed-step rebuild path every cached prefix dies with the pool
+        bytes, and mid-prefill slots lose their computed chunks — fail
+        them like the active ones the caller already failed."""
+        if self.prefix_cache is not None:
+            for i in sorted(self.prefill_queue.slots):
+                req = self.slots[i]
+                if req is not None:
+                    self._clear_slot(i)
+                    req._finish("engine cache rebuilt after a failed step")
+            self.prefix_cache.clear()
+            self._m_pages_free.set(self.pool.free_pages)
+        if self.num_pages is None:
+            # default pool = full slot-engine capacity (every slot can
+            # grow to max_seq_len); shrink it to oversubscribe
+            self.max_pages = -(-self.max_seq_len // self.page_size)
+            self.num_pages = self.num_slots * self.max_pages + 1
+        else:
+            self.max_pages = -(-self.max_seq_len // self.page_size)
+        cfg = self.cfg
+        shape = (cfg.num_layers, self.num_pages, self.page_size,
+                 cfg.n_kv_heads, cfg.head_dim)
+        if self.kv_cache_int8:
+            sshape = shape[:-1] + (1,)
+            return (jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8),
+                    jnp.zeros(sshape, jnp.float32),
+                    jnp.zeros(sshape, jnp.float32))
+        return (jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
+
+    # ----- jitted device steps --------------------------------------------
+
+    def _build_decode_step(self):
+        cfg, vocab, wlp = self.cfg, self.vocab_size, self.want_logprobs
+        from functools import partial
+
+        from megatron_tpu.models.language_model import lm_forward
+
+        @partial(jax.jit, donate_argnums=self._donate())
+        def decode_step(params, caches, table, last_tok, lengths, keys,
+                        temps, top_ks, top_ps):
+            # identical to the slot decode step except K/V writes and
+            # reads route through the page table (ops/attention.py picks
+            # the paged flash-decode kernel on TPU, the gather elsewhere)
+            logits, caches = lm_forward(cfg, params, last_tok[:, None],
+                                        kv_caches=caches,
+                                        cache_index=lengths,
+                                        page_table=table)
+            logits = logits[:, 0]
+            split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+            new_keys, subs = split[:, 0], split[:, 1]
+            toks = sample_logits_batched(logits, subs, temps, top_ks,
+                                         top_ps, vocab)
+            if wlp:
+                lp = jnp.take_along_axis(
+                    jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1),
+                    toks[:, None], axis=-1)[:, 0]
+            else:
+                lp = jnp.zeros(toks.shape, jnp.float32)
+            return toks, lp, caches, new_keys, lengths + 1
+
+        return decode_step
+
+    def _build_chunk_step(self):
+        cfg, vocab, wlp = self.cfg, self.vocab_size, self.want_logprobs
+        C = self.prefill_chunk
+        from functools import partial
+
+        from megatron_tpu.models.language_model import lm_forward
+
+        @partial(jax.jit, donate_argnums=self._donate())
+        def chunk_step(params, caches, table_row, tokens_ext, off,
+                       write_start, write_end, sample_pos, key, temp,
+                       top_k, top_p):
+            """One prefill chunk of one prompt.
+
+            tokens_ext [1, C+1]: the chunk's tokens at absolute positions
+            off..off+C-1 plus the NEXT prompt token, so the chunk scores
+            its last position's teacher-forced logprob without waiting
+            for the next chunk. Writes outside [write_start, write_end)
+            land on the scratch page (shared-prefix overlap + padded
+            tail). Every call also samples from the logits at absolute
+            position sample_pos (= prompt_len - 1); the host uses that
+            token and the advanced key only on the final chunk, so
+            non-final chunks never consume the request's PRNG chain."""
+            logits, caches = lm_forward(cfg, params, tokens_ext[:, :C],
+                                        kv_caches=caches, cache_index=off,
+                                        page_table=table_row,
+                                        page_write_start=write_start,
+                                        page_write_end=write_end)
+            if wlp:
+                lsm = jax.nn.log_softmax(logits[0].astype(jnp.float32),
+                                         axis=-1)
+                plp = jnp.take_along_axis(
+                    lsm, tokens_ext[0, 1:, None], axis=-1)[:, 0]
+            else:
+                plp = jnp.zeros((C,), jnp.float32)
+            # non-final chunks pass a sample_pos outside this chunk; the
+            # clamp keeps the (discarded) gather in bounds
+            idx = jnp.clip(sample_pos - off, 0, C - 1)
+            last = jnp.take_along_axis(
+                logits, jnp.full((1, 1, 1), idx), axis=1)[:, 0]
+            key, sub = jax.random.split(key)
+            tok = sample_logits_batched(last, sub[None], temp[None],
+                                        top_k[None], top_p[None], vocab)[0]
+            if wlp:
+                lp = jnp.take_along_axis(
+                    jax.nn.log_softmax(last.astype(jnp.float32), axis=-1),
+                    tok[None, None], axis=-1)[0, 0]
+            else:
+                lp = jnp.zeros((), jnp.float32)
+            return tok, lp, plp, caches, key
+
+        return chunk_step
+
+    # ----- page accounting -------------------------------------------------
+
+    def _alloc_pages(self, n: int) -> Optional[List[int]]:
+        """n fresh pages, evicting LRU cache-only prefix pages if the
+        free list can't cover it. None = still dry (caller defers or
+        preempts)."""
+        pages = self.pool.alloc(n)
+        if pages is None:
+            self.prefix_cache.evict(n - self.pool.free_pages)
+            pages = self.pool.alloc(n)
+        if pages is not None:
+            self._m_pages_free.set(self.pool.free_pages)
+        return pages
+
+    def _release_slot_pages(self, i: int) -> None:
+        row = self._pending_rows.pop(i, self.tables[i])
+        live = [int(p) for p in row if p != SCRATCH_PAGE]
+        if live:
+            self.pool.release(live)
+        self.tables[i] = SCRATCH_PAGE
+        self._table_dirty = True
+        self._m_pages_free.set(self.pool.free_pages)
+
+    def _clear_slot(self, i: int):
+        self._release_slot_pages(i)
+        self.prefill_queue.drop_slot(i)
+        super()._clear_slot(i)
+
+    # ----- admission -------------------------------------------------------
+
+    def _admit(self) -> int:
+        n = 0
+        for i in range(self.num_slots):
+            if self.slots[i] is not None:
+                continue
+            with self._cv:
+                req = self._queue.popleft() if self._queue else None
+            if req is None:
+                break
+            if not self._try_assign(i, req):
+                # pool can't cover the prompt right now: keep arrival
+                # order (front of the queue) and stop admitting — active
+                # slots retiring will free pages
+                with self._cv:
+                    self._queue.appendleft(req)
+                    self._m_queue.set(len(self._queue))
+                break
+            n += 1
+            with self._cv:
+                self._m_queue.set(len(self._queue))
+        return n
+
+    def _try_assign(self, i: int, req: Request) -> bool:
+        """Give req slot i: alias cached prefix pages, allocate the rest
+        of the prompt span, queue the chunked prefill. False = defer
+        (req untouched); a request no idle engine could EVER fit is
+        failed loudly instead (returns True: req was consumed)."""
+        resumed = req.resume_key is not None or bool(req.generated)
+        toks = (np.concatenate([np.asarray(req.prompt, np.int32),
+                                np.asarray(req.generated, np.int32)])
+                if resumed else np.asarray(req.prompt, np.int32))
+        p_ext = len(toks)
+        ps = self.page_size
+        hit_pages, hit_lps = self.prefix_cache.lookup(toks)
+        span = len(hit_pages) * ps
+        n_prompt_pages = -(-p_ext // ps)
+        # retain the hits BEFORE allocating: _alloc_pages may evict
+        # cache-only pages, and un-pinned hit pages are exactly that —
+        # an eviction here would free a hit page and hand it back as
+        # "fresh", mapping one physical page at two logical blocks
+        self.pool.retain(hit_pages)
+        fresh = self._alloc_pages(n_prompt_pages - len(hit_pages))
+        if fresh is None:
+            self.pool.release(hit_pages)
+            if self.num_active == 0:
+                req._finish(
+                    f"prompt needs {n_prompt_pages} pages but the pool has "
+                    f"{self.pool.free_pages} free with no active slots to "
+                    f"wait for (num_pages={self.num_pages})")
+                self.stats["rejected"] += 1
+                self._m_rejected.inc()
+                return True
+            return False
+        self._m_pages_free.set(self.pool.free_pages)
+
+        row = np.zeros(self.max_pages, np.int32)
+        row[:len(hit_pages)] = hit_pages
+        row[len(hit_pages):n_prompt_pages] = fresh
+        self._pending_rows[i] = row
+        self.slots[i] = req
+        self._admit_counter += 1
+        self._admit_seq[i] = self._admit_counter
+
+        # recompute starts one position INSIDE the shared span so the
+        # boundary token's teacher-forced logprob comes from real logits;
+        # its K/V write is fenced onto scratch (write_start = span)
+        start = max(span - 1, 0)
+        task = PrefillTask(
+            slot=i, tokens=toks, start=start, off=start,
+            write_start=span,
+            key=(np.asarray(req.resume_key) if req.resume_key is not None
+                 else np.asarray(jax.random.PRNGKey(req.seed))),
+            resumed=resumed, t_start=time.monotonic())
+        if not resumed and span > 0:
+            # cached teacher-forced logprobs for tokens 1..span-1; the
+            # recomputed chunks continue seamlessly from token `span`
+            task.plp_parts.extend(hit_lps)
+        self.prefill_queue.add(task)
+
+        if span > 0:
+            self.stats["prefix_hits"] += 1
+            self.stats["prefix_tokens_saved"] += start
+            self._m_prefix_hits.inc()
+            self._m_prefix_saved.inc(start)
+        else:
+            self.stats["prefix_misses"] += 1
+            self._m_prefix_misses.inc()
+        self.stats["admitted"] += 1
+        self._m_admitted.inc()
+        self._m_active.set(self.num_active)
+        return True
+
+    # ----- chunked prefill -------------------------------------------------
+
+    def _prefill_tick(self) -> int:
+        """Run at most ONE chunk of the oldest incomplete prefill.
+        Returns 1 when a chunk ran (progress signal for run_until_idle)."""
+        task = self.prefill_queue.peek()
+        if task is None:
+            return 0
+        i = task.slot
+        req = self.slots[i]
+        C = self.prefill_chunk
+        off = task.off
+        toks_ext = np.zeros((1, C + 1), np.int32)
+        avail = task.tokens[off:off + C + 1]
+        toks_ext[0, :len(avail)] = avail
+        row = self._pending_rows[i]
+        t0 = time.monotonic()
+        try:
+            tok, lp, plp, caches, key = self._chunk_step(
+                self.params, self.caches, jnp.asarray(row[None, :]),
+                jnp.asarray(toks_ext), jnp.int32(off),
+                jnp.int32(task.write_start), jnp.int32(task.total),
+                jnp.int32(task.total - 1), jnp.asarray(task.key),
+                jnp.float32(req.temperature), jnp.int32(req.top_k),
+                jnp.float32(req.top_p))
+        except Exception as e:  # noqa: BLE001 - a failing chunk must fail
+            # THIS request, not strand it un-signalled and kill the loop
+            # (same contract as the slot engine's prefill failure)
+            self._clear_slot(i)
+            req._finish(f"prefill failed: {e}")
+            self.stats["rejected"] += 1
+            self._m_rejected.inc()
+            if self._donate():
+                # the failed call may have consumed the donated pools
+                for j, other in enumerate(self.slots):
+                    if other is not None:
+                        self._clear_slot(j)
+                        other._finish(f"prefill failed: {e}")
+                self.caches = self._commit(self._fresh_caches())
+            self._m_active.set(self.num_active)
+            return 1
+        self.caches = caches
+        n = min(C, task.total - off)
+        if self.want_logprobs:
+            task.plp_parts.append(np.asarray(plp))
+        self.stats["prefill_chunks"] += 1
+        self.stats["prefill_tokens"] += n
+        self._m_chunks.inc()
+        self._m_chunk.observe(time.monotonic() - t0)
+        if self.flight_recorder is not None:
+            self.flight_recorder.heartbeat(
+                f"prefill chunk slot {i} ({off}+{n}/{task.total})")
+        if self.prefill_queue.advance(task, n):
+            self._finish_prefill(i, task, tok, lp, key)
+        return 1
+
+    def _finish_prefill(self, i: int, task: PrefillTask, tok, lp, key):
+        """The prompt is fully in the cache: publish the slot's table row
+        to the shared decode table, arm the decode mirrors, record the
+        first sampled token, and register the prompt's full pages in the
+        radix tree."""
+        self._sync_carry()
+        req = self.slots[i]
+        row = self._pending_rows.pop(i)
+        self.tables[i] = row
+        self._table_dirty = True
+        p_ext = task.total
+        self.lengths[i] = p_ext
+        self.last_tok[i] = int(tok)
+        self.temps[i] = req.temperature
+        self.top_ks[i] = req.top_k
+        self.top_ps[i] = req.top_p
+        self.keys[i] = np.asarray(key)
+        req.generated.append(int(tok))
+        req.logprobs.append(float(lp))
+        if not task.resumed and self.want_logprobs:
+            req.prompt_logprobs = [
+                float(x) for x in np.concatenate(task.plp_parts)[:p_ext - 1]
+            ] if task.plp_parts else []
+        p0 = len(req.prompt)
+        if p0 >= self.page_size:
+            # only FULL pages of the ORIGINAL prompt enter the tree (the
+            # partially-filled tail page stays private — decode writes
+            # into it); resumes re-register recomputed pages, and insert
+            # skips paths already cached
+            self.prefix_cache.insert(req.prompt,
+                                     [int(p) for p in
+                                      row[:p0 // self.page_size]],
+                                     req.prompt_logprobs)
+        now = time.monotonic()
+        self._m_prefill.observe(now - task.t_start)
+        if not task.resumed:
+            req.first_token_time = now
+            if req.submit_time is not None:
+                self._m_ttft.observe(now - req.submit_time)
+        self._m_tokens.inc()
+        if self._req_finished(req):
+            self._retire(i)
+
+    # ----- preemption ------------------------------------------------------
+
+    def _preempt_one(self) -> bool:
+        """Preempt the youngest active slot (LIFO — later arrivals yield
+        pages to earlier ones). Its request re-enters the queue FRONT and
+        resumes by exact teacher-forced recompute."""
+        cands = [i for i in range(self.num_slots) if self.slots[i] is not None]
+        if not cands:
+            return False
+        i = max(cands, key=lambda j: self._admit_seq[j])
+        self._sync_carry()
+        req = self.slots[i]
+        if i not in self.prefill_queue.slots:
+            # mid-decode: preserve the PRNG chain so the resumed request
+            # samples exactly the tokens it would have sampled
+            req.resume_key = self.keys[i].copy()
+        self._clear_slot(i)
+        with self._cv:
+            self._queue.appendleft(req)
+            self._m_queue.set(len(self._queue))
+        self.stats["preemptions"] += 1
+        self._m_preempted.inc()
+        self._m_active.set(self.num_active)
+        return True
+
+    def _ensure_decode_pages(self) -> None:
+        """Before a decode tick, every decodable slot needs a real page
+        under its write position (lengths[i]); allocate across page
+        boundaries, preempting the youngest slot when the pool is dry.
+        Each preemption frees that slot's pages, so this terminates."""
+        while True:
+            rows = self._decode_rows()
+            for i in rows:
+                pg = int(self.lengths[i]) // self.page_size
+                if self.tables[i, pg] != SCRATCH_PAGE:
+                    continue
+                pages = self._alloc_pages(1)
+                if pages is None:
+                    if not self._preempt_one():
+                        # unreachable: slot i itself is preemptible
+                        return
+                    break  # re-derive rows (the victim may be in them)
+                self.tables[i, pg] = pages[0]
+                self._table_dirty = True
+            else:
+                return
+
+    # ----- stepping --------------------------------------------------------
+
+    def _decode_rows(self):
+        busy = self.prefill_queue.slots
+        return [i for i, s in enumerate(self.slots)
+                if s is not None and i not in busy]
+
+    def _decode_extra_args(self):
+        if self._table_dirty or self._device_table is None:
+            self._device_table = self._commit(jnp.asarray(self.tables))
+            self._table_dirty = False
+        return (self._device_table,)
+
+    def step(self) -> int:
+        """One engine tick: admit, run one prefill chunk, then one
+        batched decode for every slot whose prompt is fully cached.
+        Returns slots served + chunks run (0 = idle)."""
+        self._admit()
+        chunked = self._prefill_tick()
+        self._ensure_decode_pages()
+        return self._decode_tick() + chunked
+
+    def _retire(self, i: int):
+        # base _retire -> _clear_slot releases this slot's page refs;
+        # pages also held by the radix tree stay cached for future hits
+        super()._retire(i)
+        self._m_pages_free.set(self.pool.free_pages)

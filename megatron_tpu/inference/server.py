@@ -43,25 +43,37 @@ from megatron_tpu.config import ModelConfig
 from megatron_tpu.inference.api import (
     beam_search_and_post_process, generate_and_post_process,
 )
+from megatron_tpu.inference.engine import EngineOverloadedError
 from megatron_tpu.telemetry.http import PROMETHEUS_CONTENT_TYPE
 from megatron_tpu.telemetry.metrics import MetricsRegistry, default_registry
 
 MAX_TOKENS_TO_GENERATE = 1024  # ref caps requests similarly
 MAX_PROMPTS = 128
+#: Retry-After hint on 503 queue-full rejections: one decode tick's
+#: worth of backoff is enough for a slot to free in steady traffic
+RETRY_AFTER_SECONDS = 1
 
 
 class GenerationService:
     def __init__(self, cfg: ModelConfig, params: Any, tokenizer,
                  mesh=None, forward_fn=None, kv_cache_int8=False,
                  engine_slots: int = 0, engine_max_seq_len=None,
-                 metrics: Optional[MetricsRegistry] = None):
+                 metrics: Optional[MetricsRegistry] = None,
+                 engine_max_queue: Optional[int] = None,
+                 kv_paging: bool = False, page_size: int = 16,
+                 prefill_chunk: int = 32,
+                 num_pages: Optional[int] = None):
         """mesh + forward_fn serve sharded models: the mesh becomes
         ambient around generation (GSPMD handles tp/cp), forward_fn is the
         pp>1 pipelined forward (ref ForwardStep, forward_step.py:45-204).
 
         engine_slots > 0 builds a continuous-batching InferenceEngine with
         that many KV-cache slots plus its background step-loop thread;
-        concurrent sampling requests then share each decode tick."""
+        concurrent sampling requests then share each decode tick.
+        kv_paging swaps in the PagedInferenceEngine (shared page pool +
+        radix prefix cache + chunked prefill, docs/serving.md);
+        engine_max_queue bounds admission — overload answers 503 with
+        Retry-After instead of growing queue latency without bound."""
         if kv_cache_int8 and forward_fn is not None:
             # fail at construction, not as a 500 on every request — the
             # pipelined forward threads bf16 cache pairs (the same guard
@@ -90,14 +102,26 @@ class GenerationService:
             "server_request_seconds", "API request wall time")
         self.engine = None
         if engine_slots:
-            from megatron_tpu.inference.engine import InferenceEngine
+            if kv_paging:
+                from megatron_tpu.inference.paging import PagedInferenceEngine
 
-            self.engine = InferenceEngine(
-                cfg, params, num_slots=engine_slots,
-                max_seq_len=engine_max_seq_len,
-                kv_cache_int8=kv_cache_int8,
-                vocab_size=tokenizer.vocab_size, mesh=mesh,
-                metrics=self.metrics)
+                self.engine = PagedInferenceEngine(
+                    cfg, params, num_slots=engine_slots,
+                    max_seq_len=engine_max_seq_len,
+                    kv_cache_int8=kv_cache_int8,
+                    page_size=page_size, prefill_chunk=prefill_chunk,
+                    num_pages=num_pages,
+                    vocab_size=tokenizer.vocab_size, mesh=mesh,
+                    metrics=self.metrics, max_queue=engine_max_queue)
+            else:
+                from megatron_tpu.inference.engine import InferenceEngine
+
+                self.engine = InferenceEngine(
+                    cfg, params, num_slots=engine_slots,
+                    max_seq_len=engine_max_seq_len,
+                    kv_cache_int8=kv_cache_int8,
+                    vocab_size=tokenizer.vocab_size, mesh=mesh,
+                    metrics=self.metrics, max_queue=engine_max_queue)
             self.engine.start()
 
     def shutdown(self) -> None:
@@ -170,11 +194,13 @@ class GenerationService:
 
 def make_handler(service: GenerationService):
     class Handler(BaseHTTPRequestHandler):
-        def _reply(self, code: int, payload: dict):
+        def _reply(self, code: int, payload: dict, headers=()):
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for name, value in headers:
+                self.send_header(name, value)
             self.end_headers()
             self.wfile.write(body)
 
@@ -187,6 +213,13 @@ def make_handler(service: GenerationService):
                 payload = service.handle(req)
                 status = "200"
                 self._reply(200, payload)
+            except EngineOverloadedError as e:
+                # bounded admission (--serve_max_queue): overload degrades
+                # to fast 503s clients can back off on, not queue latency
+                status = "503"
+                self._reply(503, {"message": str(e)},
+                            headers=(("Retry-After",
+                                      str(RETRY_AFTER_SECONDS)),))
             except ValueError as e:
                 status = "400"
                 self._reply(400, {"message": str(e)})
@@ -231,15 +264,24 @@ def make_handler(service: GenerationService):
 def run_server(cfg: ModelConfig, params: Any, tokenizer,
                host: str = "0.0.0.0", port: int = 5000,
                mesh=None, forward_fn=None, kv_cache_int8=False,
-               engine_slots: int = 0, engine_max_seq_len=None) -> None:
+               engine_slots: int = 0, engine_max_seq_len=None,
+               engine_max_queue: Optional[int] = None,
+               kv_paging: bool = False, page_size: int = 16,
+               prefill_chunk: int = 32,
+               num_pages: Optional[int] = None) -> None:
     service = GenerationService(cfg, params, tokenizer, mesh=mesh,
                                 forward_fn=forward_fn,
                                 kv_cache_int8=kv_cache_int8,
                                 engine_slots=engine_slots,
-                                engine_max_seq_len=engine_max_seq_len)
+                                engine_max_seq_len=engine_max_seq_len,
+                                engine_max_queue=engine_max_queue,
+                                kv_paging=kv_paging, page_size=page_size,
+                                prefill_chunk=prefill_chunk,
+                                num_pages=num_pages)
     server = ThreadingHTTPServer((host, port), make_handler(service))
-    mode = (f"continuous batching, {engine_slots} slots" if service.engine
-            else "one-shot")
+    mode = (f"continuous batching, {engine_slots} slots"
+            + (", paged KV + prefix cache" if kv_paging else "")
+            if service.engine else "one-shot")
     print(f"serving generation API on http://{host}:{port}/api ({mode})")
     try:
         server.serve_forever()

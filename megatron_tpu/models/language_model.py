@@ -198,11 +198,20 @@ def lm_forward(
     return_moe_aux: bool = False,
     attention_mask: Optional[jnp.ndarray] = None,  # [B, S] True = attend
     tokentype_ids: Optional[jnp.ndarray] = None,   # [B, S] (BERT segments)
+    page_table: Optional[jnp.ndarray] = None,      # [B, max_pages] int32
+    page_write_start: Optional[jnp.ndarray] = None,
+    page_write_end: Optional[jnp.ndarray] = None,
 ):
     """Forward pass to logits.
 
     kv_caches: stacked per-layer caches for incremental decoding; when
     given, returns (logits, updated_caches).
+
+    page_table: the caches are PAGED pools [L, num_pages, page_size,
+    nkv, D] (inference/paging/) shared by every slot; each row's logical
+    context is page_table[b] physical pages. The table is broadcast to
+    all layers (the paging engine allocates one table per slot, not per
+    layer).
     """
     if positions is None and kv_caches is not None:
         # incremental decode: q tokens sit at absolute positions
@@ -225,7 +234,11 @@ def lm_forward(
 
     rope = None
     if cfg.position_embedding_type == "rotary":
-        if kv_caches is not None:
+        if kv_caches is not None and page_table is not None:
+            # paged pools are [L, num_pages, page_size, ...]: the logical
+            # max length is the table width x page size, not shape[2]
+            rope_len = page_table.shape[1] * kv_caches[0].shape[2]
+        elif kv_caches is not None:
             rope_len = kv_caches[0].shape[2]  # cache max length
         else:
             rope_len = max(cfg.seq_length, tokens.shape[1])
@@ -246,6 +259,9 @@ def lm_forward(
             cache_index=cache_index,
             sharder=sharder,
             padding_mask=attention_mask,
+            page_table=page_table,
+            page_write_start=page_write_start,
+            page_write_end=page_write_end,
         )
         return (y, aux + moe_aux), new_cache
 

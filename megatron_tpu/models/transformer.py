@@ -64,8 +64,31 @@ def attention_block(
     kv_cache: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
     cache_index=None,
     padding_mask: Optional[jnp.ndarray] = None,  # [B, S] True = attend
+    page_table: Optional[jnp.ndarray] = None,    # [B, max_pages] int32
+    page_write_start: Optional[jnp.ndarray] = None,  # scalar int32
+    page_write_end: Optional[jnp.ndarray] = None,    # scalar int32
 ) -> Tuple[jnp.ndarray, Optional[Tuple[jnp.ndarray, jnp.ndarray]]]:
-    """Returns (out [B,S,h], updated kv_cache)."""
+    """Returns (out [B,S,h], updated kv_cache).
+
+    page_table: the cache tuple holds PAGED pools [num_pages, page_size,
+    nkv, D] (inference/paging/) instead of dense [B, S, nkv, D] buffers;
+    new K/V scatters through the table to each position's physical page
+    and attention reads back through it (ops/attention.py). Two shapes:
+    single-token decode (vector cache_index — every slot at its own
+    depth) and single-row chunked prefill (traced scalar cache_index,
+    s > 1, batch 1 — one chunk of one prompt lands at positions
+    cache_index..cache_index+s-1).
+
+    page_write_start / page_write_end (chunked prefill only): positions
+    outside [start, end) redirect their K/V write to the reserved
+    scratch page. The first chunk after a prefix-cache hit starts ONE
+    position inside the shared span (so the boundary token's
+    teacher-forced logprob is recomputed exactly), and the start fence
+    keeps that overlap query from rewriting a refcount-shared page —
+    shared pages are copy-on-write: never written through a sharer's
+    table. The end fence (the prompt length) parks the final chunk's
+    padded-tail writes on scratch, where an index-clipped write could
+    otherwise scribble a live page."""
     b, s, _ = x.shape
     D = cfg.head_dim
     nq, nkv = cfg.num_attention_heads, cfg.n_kv_heads
@@ -105,9 +128,69 @@ def attention_block(
         raise ValueError(
             f"per-slot cache_index requires single-token decode (s={s})")
 
+    paged = page_table is not None
+    if paged:
+        if kv_cache is None:
+            raise ValueError("page_table requires a (paged) kv_cache")
+        cp_prefill = False  # paged serving is single-chip scope, like int8
+        if not per_slot and b != 1:
+            raise ValueError(
+                f"paged chunked prefill is single-row (batch {b})")
+
+    def _paged_write(store, new):
+        """Scatter new rows through the page table. Decode: new [B,1,...]
+        lands at each row's own depth. Chunk: new [1,C,...] lands at
+        positions cache_index..cache_index+C-1 of row 0."""
+        ps = store.shape[1]
+        if per_slot:
+            pos = cache_index                              # [B]
+            phys = jnp.take_along_axis(
+                page_table, (pos // ps)[:, None], axis=1,
+                mode="clip")[:, 0]
+            return store.at[phys, pos % ps].set(
+                new[:, 0].astype(store.dtype))
+        pos = cache_index + jnp.arange(s)                  # [C]
+        phys = jnp.take(page_table[0], pos // ps, mode="clip")
+        if page_write_start is not None:
+            # overlap queries below the write fence read the shared pages
+            # but park their (identical-valued) K/V on scratch
+            phys = jnp.where(pos >= page_write_start, phys, 0)
+        if page_write_end is not None:
+            # padded-tail queries past the prompt park on scratch too
+            phys = jnp.where(pos < page_write_end, phys, 0)
+        return store.at[phys, pos % ps].set(new[0].astype(store.dtype))
+
     q_offset = 0
     kv_lengths = None
-    if kv_cache is not None and len(kv_cache) == 4:
+    if paged and len(kv_cache) == 4:
+        # int8 paged pools: quantize the new rows on write, dequantize the
+        # whole pool for attention — the same numerics as the dense int8
+        # slot cache (quantize-once, dequantize-everything), so the paged
+        # engine stays token-identical to the slot engine in int8 mode
+        from megatron_tpu.ops.kv_quant import dequantize_kv, quantize_kv
+
+        kq, vq, ks, vs = kv_cache
+        knew, ksnew = quantize_kv(k)
+        vnew, vsnew = quantize_kv(v)
+        kq, vq = _paged_write(kq, knew), _paged_write(vq, vnew)
+        ks, vs = _paged_write(ks, ksnew), _paged_write(vs, vsnew)
+        kv_cache = (kq, vq, ks, vs)
+        k = dequantize_kv(kq, ks, cfg.dtype)
+        v = dequantize_kv(vq, vs, cfg.dtype)
+        if per_slot:
+            kv_lengths = cache_index + 1
+        else:
+            q_offset = cache_index
+    elif paged:
+        kc, vc = kv_cache
+        kc, vc = _paged_write(kc, k), _paged_write(vc, v)
+        kv_cache = (kc, vc)
+        k, v = kc, vc
+        if per_slot:
+            kv_lengths = cache_index + 1
+        else:
+            q_offset = cache_index
+    elif kv_cache is not None and len(kv_cache) == 4:
         # int8 KV cache (serving option): quantize the new K/V slice on
         # write, dequantize the whole cache for attention — cache bytes
         # halve vs bf16 (ops/kv_quant.py)
@@ -171,6 +254,7 @@ def attention_block(
         impl=cfg.attention_impl,
         softmax_fp32=cfg.softmax_fp32,
         kv_lengths=kv_lengths,
+        page_table=page_table,
     )
     out = maybe_fp8_matmul(cfg, ctx.reshape(b, s, nq * D),
                            deq(p["wo"], ctx.dtype))
@@ -209,6 +293,9 @@ def block_forward(
     cache_index=None,
     sharder: Sharder = _identity_sharder,
     padding_mask: Optional[jnp.ndarray] = None,
+    page_table: Optional[jnp.ndarray] = None,  # [B, max_pages] int32
+    page_write_start: Optional[jnp.ndarray] = None,
+    page_write_end: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, Optional[Tuple[jnp.ndarray, jnp.ndarray]], jnp.ndarray]:
     """One decoder layer -> (y, kv_cache, moe_aux_loss).
 
@@ -229,6 +316,9 @@ def block_forward(
         attn_dropout_key=k_attn_drop if cfg.attention_dropout > 0 else None,
         kv_cache=kv_cache, cache_index=cache_index,
         padding_mask=padding_mask,
+        page_table=page_table,
+        page_write_start=page_write_start,
+        page_write_end=page_write_end,
     )
     attn_out = _dropout(attn_out, rate, k_hidden1 if cfg.hidden_dropout > 0 else None)
 

@@ -64,6 +64,7 @@ def attention(
     impl: str = "xla",
     softmax_fp32: bool = True,
     kv_lengths: Optional[jnp.ndarray] = None,  # [B] valid-prefix lengths
+    page_table: Optional[jnp.ndarray] = None,  # [B, max_pages] int32
 ) -> jnp.ndarray:
     """Scaled dot-product attention with GQA. Returns [B, Sq, Hq, D].
 
@@ -77,7 +78,37 @@ def attention(
     runs the fused flash-decode kernel (ops/pallas/flash_decode.py) which
     skips cache blocks past each row's prefix; elsewhere a masked einsum
     computes the same values.
+
+    page_table: paged KV cache (inference/paging/): k/v are the shared
+    page pools [num_pages, page_size, Hkv, D] and each row's logical
+    context is page_table[b] physical pages. With kv_lengths (single-token
+    decode) the TPU path is the paged flash-decode kernel
+    (ops/pallas/paged_flash_decode.py) which resolves pages inside the
+    grid; everywhere else the pages are gathered into a dense [B, S, ...]
+    view and the existing masked paths compute identical values (the
+    gather is exact — pages hold the same bits a dense cache would).
     """
+    if page_table is not None:
+        if (kv_lengths is not None and q.shape[1] == 1
+                and impl == "pallas" and jax.default_backend() != "cpu"):
+            try:
+                from megatron_tpu.ops.pallas.paged_flash_decode import (
+                    paged_flash_decode,
+                )
+
+                return paged_flash_decode(q, k, v, page_table, kv_lengths,
+                                          sliding_window=sliding_window)
+            except (ImportError, ValueError) as e:
+                warnings.warn(
+                    f"paged flash-decode kernel unavailable ({e}); falling "
+                    "back to the gathered masked-einsum decode path",
+                    stacklevel=2)
+        # masked-einsum gather fallback (exact): materialize each row's
+        # logical context from its pages, then flow into the dense paths
+        # below unchanged
+        bq = q.shape[0]
+        k = k[page_table].reshape(bq, -1, *k.shape[-2:])
+        v = v[page_table].reshape(bq, -1, *v.shape[-2:])
     if kv_lengths is not None:
         if q.shape[1] != 1:
             raise ValueError(

@@ -338,6 +338,25 @@ def test_decode_step_audit_clean():
     assert len(don.donated) == 2, don.donated  # the k/v cache stacks
 
 
+def test_paged_decode_step_audit_clean():
+    """Paged engine decode step (page-table gather + scatter): same
+    contract as the slot decode step — zero collectives, zero host
+    callbacks, the page pools donated; the only tolerated bf16->f32
+    promotion is softmax_fp32's per-layer K upcast (here the GATHERED
+    [slots, max_pages*page_size, Hkv, D] view)."""
+    t = targets.paged_decode_step_target()
+    rep = jaxpr_audit.audit_jaxpr(t.jaxpr(), t.name)
+    assert rep.collectives == []
+    assert rep.callbacks == []
+    unexpected = [p for p in rep.promotions
+                  if not (p.shape == (4, 32, 2, 8) and p.calls == 4)]
+    assert unexpected == [], unexpected
+    assert len(rep.promotions) <= 1
+
+    don = jaxpr_audit.audit_donation(t.lowered())
+    assert len(don.donated) == 2, don.donated  # the k/v page pools
+
+
 # ---------------------------------------------------------------------------
 # golden comm contracts
 # ---------------------------------------------------------------------------

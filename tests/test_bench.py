@@ -49,13 +49,21 @@ def test_bench_main_emits_one_json_line(monkeypatch):
     with redirect_stdout(buf):
         bench.main()
     lines = [l for l in buf.getvalue().splitlines() if l.strip()]
-    # full (non-quick) runs: serving metric line, then the headline LAST
-    assert len(lines) == 2
+    # full (non-quick) runs: the serving metric lines, then the headline
+    # LAST (the only positional contract the driver relies on)
+    assert len(lines) == 3
     serve = json.loads(lines[0])
     assert serve["metric"] == "serve_decode_throughput_toks_per_s"
     assert set(serve) >= {"metric", "value", "unit", "vs_baseline"}
     assert "error" not in serve and serve["value"] > 0
     assert serve["detail"]["decode_recompiles_after_warmup"] == 0
+    prefix = json.loads(lines[1])
+    assert prefix["metric"] == "serve_prefix_cache_speedup"
+    assert "error" not in prefix, prefix
+    # the acceptance floor: >= 1.5x prefill-token savings on
+    # shared-system-prompt traffic via the radix prefix cache
+    assert prefix["value"] >= 1.5, prefix
+    assert prefix["detail"]["decode_recompiles_after_warmup"] == 0
     out = json.loads(lines[-1])
     assert out["metric"] == "llama_train_step_mfu"
     assert set(out) >= {"metric", "value", "unit", "vs_baseline", "detail"}

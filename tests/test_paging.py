@@ -1,0 +1,289 @@
+"""Paged-KV subsystem units (inference/paging/ + the paged kernel).
+
+Pool/radix/scheduler tests are pure host bookkeeping (no compiles);
+the kernel test runs the Pallas paged flash-decode in interpret mode
+against a gather + masked-softmax reference. Engine-level parity lives
+in tests/test_serving_engine.py (the serving matrix).
+"""
+
+import numpy as np
+import pytest
+
+from megatron_tpu.inference.paging.pool import SCRATCH_PAGE, PagePool
+from megatron_tpu.inference.paging.radix import RadixPrefixCache
+from megatron_tpu.inference.paging.scheduler import (
+    ChunkedPrefillQueue, PrefillTask,
+)
+
+# ---------------------------------------------------------------------------
+# page pool
+
+
+def test_pool_alloc_release_refcount():
+    pool = PagePool(6)  # pages 1..5 usable
+    assert pool.free_pages == 5 and pool.used_pages == 0
+    a = pool.alloc(2)
+    assert len(a) == 2 and all(p != SCRATCH_PAGE for p in a)
+    assert pool.free_pages == 3 and pool.used_pages == 2
+    pool.retain(a)  # second holder
+    assert pool.release(a) == 0  # refs drop 2 -> 1, nothing freed
+    assert pool.release(a) == 2  # 1 -> 0: both return
+    assert pool.free_pages == 5
+
+
+def test_pool_alloc_all_or_nothing():
+    pool = PagePool(4)
+    assert pool.alloc(5) is None  # over-ask leaks nothing
+    assert pool.free_pages == 3
+    assert pool.alloc(3) is not None
+    assert pool.alloc(1) is None
+
+
+def test_pool_misuse_raises():
+    pool = PagePool(4)
+    (p,) = pool.alloc(1)
+    pool.release([p])
+    with pytest.raises(ValueError):
+        pool.release([p])  # double release
+    with pytest.raises(ValueError):
+        pool.retain([p])  # retain of a free page
+    with pytest.raises(ValueError):
+        PagePool(1)  # no room beyond the scratch page
+    # scratch page is never tracked
+    pool.retain([SCRATCH_PAGE])
+    pool.release([SCRATCH_PAGE])
+
+
+# ---------------------------------------------------------------------------
+# radix prefix cache
+
+
+def _cache(ps=4, pages=32):
+    pool = PagePool(pages)
+    return pool, RadixPrefixCache(pool, ps)
+
+
+def test_radix_insert_lookup_longest_prefix():
+    pool, cache = _cache()
+    toks = list(range(10, 22))  # 12 tokens = 3 full pages
+    pages = pool.alloc(3)
+    lps = [float(-t) for t in range(1, 12)]  # scores tokens 1..11
+    assert cache.insert(toks, pages, lps) == 3
+    # full match
+    hit, hlps = cache.lookup(toks)
+    assert hit == pages
+    np.testing.assert_allclose(np.concatenate(hlps), lps)
+    # partial match: first 8 tokens shared, then diverges
+    hit, _ = cache.lookup(toks[:8] + [99, 98, 97, 96])
+    assert hit == pages[:2]
+    # sub-page tails never match
+    hit, _ = cache.lookup(toks[:6])
+    assert hit == pages[:1]
+    assert cache.lookup([1, 2, 3, 4])[0] == []
+
+
+def test_radix_insert_skips_existing_nodes():
+    pool, cache = _cache()
+    toks = list(range(8))
+    pages = pool.alloc(2)
+    cache.insert(toks, pages, [0.0] * 7)
+    dup = pool.alloc(2)  # a second slot recomputed the same prefix
+    assert cache.insert(toks, dup, [0.0] * 7) == 0  # existing copy wins
+    assert cache.lookup(toks)[0] == pages
+    assert pool.refcount(pages[0]) == 2  # alloc + cache
+    assert pool.refcount(dup[0]) == 1  # duplicate stays slot-private
+
+
+def test_radix_evict_lru_leaves_only():
+    pool, cache = _cache()
+    old = list(range(8))
+    new = list(range(100, 108))
+    p_old, p_new = pool.alloc(2), pool.alloc(2)
+    cache.insert(old, p_old, [0.0] * 7)
+    cache.insert(new, p_new, [0.0] * 7)
+    pool.release(p_old)
+    pool.release(p_new)  # cache is now the only holder
+    cache.lookup(new)  # touch: `new` is most-recently-used
+    assert cache.evict(2) == 2
+    assert cache.lookup(old)[0] == []  # LRU path died first
+    assert cache.lookup(new)[0] == p_new
+
+
+def test_radix_evict_spares_pages_slots_still_reference():
+    pool, cache = _cache()
+    toks = list(range(8))
+    pages = pool.alloc(2)  # the "slot's" references
+    cache.insert(toks, pages, [0.0] * 7)
+    assert cache.evict(2) == 0  # refcount 2: not evictable
+    pool.release(pages)
+    assert cache.evict(2) == 2  # now cache-only -> evictable
+    assert pool.free_pages == pool.num_pages - 1
+
+
+def test_radix_clear_releases_everything():
+    pool, cache = _cache()
+    pages = pool.alloc(3)
+    cache.insert(list(range(12)), pages, [0.0] * 11)
+    pool.release(pages)
+    assert cache.clear() == 3
+    assert len(cache) == 0 and pool.free_pages == pool.num_pages - 1
+
+
+# ---------------------------------------------------------------------------
+# chunked-prefill queue
+
+
+def test_prefill_queue_fifo_and_advance():
+    q = ChunkedPrefillQueue(chunk=4)
+    t1 = PrefillTask(slot=0, tokens=np.arange(10, dtype=np.int32),
+                     start=0, off=0)
+    t2 = PrefillTask(slot=1, tokens=np.arange(6, dtype=np.int32),
+                     start=0, off=0)
+    q.add(t1)
+    q.add(t2)
+    assert q.slots == {0, 1}
+    assert q.peek() is t1  # oldest incomplete first
+    assert not q.advance(t1, 4)
+    assert q.peek() is t1  # still t1 until it completes
+    assert not q.advance(t1, 4)
+    assert q.advance(t1, 2)  # 10/10 done, removed
+    assert q.peek() is t2
+    assert q.advance(t2, 6)
+    assert q.peek() is None and len(q) == 0
+
+
+def test_prefill_queue_drop_slot_and_validation():
+    q = ChunkedPrefillQueue(chunk=4)
+    t = PrefillTask(slot=3, tokens=np.arange(8, dtype=np.int32),
+                    start=2, off=0)
+    q.add(t)
+    assert t.off == 2  # add() rewinds off to start
+    assert q.drop_slot(3) is t
+    assert q.drop_slot(3) is None
+    with pytest.raises(ValueError):
+        # a fully-cached prompt must leave >= 1 position to recompute
+        q.add(PrefillTask(slot=0, tokens=np.arange(4, dtype=np.int32),
+                          start=4, off=0))
+    with pytest.raises(ValueError):
+        ChunkedPrefillQueue(chunk=0)
+
+
+# ---------------------------------------------------------------------------
+# paged flash-decode kernel (interpret mode on CPU)
+
+
+def test_paged_flash_decode_matches_gather_reference():
+    """Page-table KV gather inside the Pallas grid vs a dense gather +
+    masked softmax: GQA, per-row prefix lengths, scratch-mapped entries,
+    sliding window."""
+    import jax.numpy as jnp
+
+    from megatron_tpu.ops.pallas.paged_flash_decode import paged_flash_decode
+
+    rng = np.random.default_rng(0)
+    B, P, ps, Hq, Hkv, D = 3, 9, 8, 4, 2, 16
+    max_pages = 4
+    q = jnp.asarray(rng.standard_normal((B, 1, Hq, D)), jnp.float32)
+    kp = jnp.asarray(rng.standard_normal((P, ps, Hkv, D)), jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((P, ps, Hkv, D)), jnp.float32)
+    table = rng.integers(1, P, (B, max_pages)).astype(np.int32)
+    table[0, 1:] = 0  # unallocated entries point at scratch
+    lens = np.asarray([1, 17, 32], np.int32)
+
+    def ref(window=None):
+        k = np.asarray(kp)[table].reshape(B, -1, Hkv, D)
+        v = np.asarray(vp)[table].reshape(B, -1, Hkv, D)
+        qg = (np.asarray(q, np.float64) / np.sqrt(D)).reshape(
+            B, 1, Hkv, Hq // Hkv, D)
+        s = np.einsum("bqhgd,bkhd->bhgqk", qg, k.astype(np.float64))
+        k_pos = np.arange(max_pages * ps)[None, :]
+        allowed = k_pos < lens[:, None]
+        if window is not None:
+            allowed &= k_pos >= lens[:, None] - window
+        s = np.where(allowed[:, None, None, None, :], s, -np.inf)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        o = np.einsum("bhgqk,bkhd->bqhgd", p, v.astype(np.float64))
+        return o.reshape(B, 1, Hq, D)
+
+    out = paged_flash_decode(q, kp, vp, jnp.asarray(table),
+                             jnp.asarray(lens))
+    np.testing.assert_allclose(np.asarray(out), ref(), atol=2e-6)
+    out_w = paged_flash_decode(q, kp, vp, jnp.asarray(table),
+                               jnp.asarray(lens), sliding_window=8)
+    np.testing.assert_allclose(np.asarray(out_w), ref(window=8), atol=2e-6)
+
+
+def test_paged_flash_decode_rejects_bad_shapes():
+    import jax.numpy as jnp
+
+    from megatron_tpu.ops.pallas.paged_flash_decode import paged_flash_decode
+
+    q = jnp.zeros((2, 1, 4, 8))
+    kp = jnp.zeros((4, 8, 2, 8))
+    table = jnp.zeros((2, 2), jnp.int32)
+    lens = jnp.zeros((2,), jnp.int32)
+    with pytest.raises(ValueError, match="single-token"):
+        paged_flash_decode(jnp.zeros((2, 3, 4, 8)), kp, kp, table, lens)
+    with pytest.raises(ValueError, match="multiple of 8"):
+        paged_flash_decode(q, jnp.zeros((4, 6, 2, 8)),
+                           jnp.zeros((4, 6, 2, 8)), table, lens)
+    with pytest.raises(ValueError, match="rows"):
+        paged_flash_decode(q, kp, kp, jnp.zeros((3, 2), jnp.int32), lens)
+
+
+def test_attention_page_table_gather_matches_dense():
+    """attention(page_table=...) on CPU gathers pages into the identical
+    dense view: single-token decode (kv_lengths) and chunked prefill
+    (causal + q_offset) both match the dense cache bit-for-bit."""
+    import jax.numpy as jnp
+
+    from megatron_tpu.ops.attention import attention
+
+    rng = np.random.default_rng(1)
+    B, P, ps, H, D = 2, 7, 4, 2, 8
+    max_pages = 3
+    kp = jnp.asarray(rng.standard_normal((P, ps, H, D)), jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((P, ps, H, D)), jnp.float32)
+    table = jnp.asarray(rng.integers(1, P, (B, max_pages)), jnp.int32)
+    dense_k = kp[table].reshape(B, -1, H, D)
+    dense_v = vp[table].reshape(B, -1, H, D)
+
+    # decode shape
+    q = jnp.asarray(rng.standard_normal((B, 1, H, D)), jnp.float32)
+    lens = jnp.asarray([3, 12], jnp.int32)
+    got = attention(q, kp, vp, kv_lengths=lens, page_table=table)
+    want = attention(q, dense_k, dense_v, kv_lengths=lens)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    # chunked-prefill shape (batch 1, causal with offset)
+    qc = jnp.asarray(rng.standard_normal((1, 4, H, D)), jnp.float32)
+    got = attention(qc, kp, vp, mask_type="causal", q_offset=5,
+                    page_table=table[:1])
+    want = attention(qc, dense_k[:1], dense_v[:1], mask_type="causal",
+                     q_offset=5)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+# ---------------------------------------------------------------------------
+# engine sizing / rejection edges (host-only where possible)
+
+
+def test_paged_engine_rejects_undersized_pool():
+    import jax
+
+    from megatron_tpu.inference.paging import PagedInferenceEngine
+    from megatron_tpu.models import presets
+    from megatron_tpu.models.params import init_params
+
+    cfg = presets.tiny(vocab_size=64, seq_length=64)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="cannot hold even one"):
+        PagedInferenceEngine(cfg, params, num_slots=2, max_seq_len=64,
+                             page_size=8, num_pages=4)
+    with pytest.raises(ValueError, match="num_pages"):
+        PagedInferenceEngine(cfg, params, num_slots=1, max_seq_len=64,
+                             page_size=8, num_pages=1)
+    with pytest.raises(ValueError, match="page_size"):
+        PagedInferenceEngine(cfg, params, num_slots=1, max_seq_len=64,
+                             page_size=0)

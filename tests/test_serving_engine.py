@@ -29,8 +29,11 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from megatron_tpu.inference.engine import InferenceEngine, Request
+from megatron_tpu.inference.engine import (
+    EngineOverloadedError, InferenceEngine, Request,
+)
 from megatron_tpu.inference.generation import generate_tokens
+from megatron_tpu.inference.paging import PagedInferenceEngine
 from megatron_tpu.inference.sampling import sample_logits, sample_logits_batched
 from megatron_tpu.models import presets
 from megatron_tpu.models.params import init_params
@@ -44,6 +47,14 @@ def make_engine(**kw):
     kw.setdefault("num_slots", 4)
     kw.setdefault("max_seq_len", 64)
     return InferenceEngine(CFG, PARAMS, **kw)
+
+
+def make_paged(**kw):
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("prefill_chunk", 8)
+    return PagedInferenceEngine(CFG, PARAMS, **kw)
 
 
 # ---------------------------------------------------------------------------
@@ -252,6 +263,305 @@ def test_slot_reuse_does_not_leak_stale_cache():
                                 max_new_tokens=6))
     eng2.run_until_idle()
     assert short.generated == fresh.generated
+
+
+# ---------------------------------------------------------------------------
+# paged engine parity matrix (inference/paging/): token-identical to the
+# slot engine on the same traffic, zero decode recompiles after warmup
+
+
+def test_paged_engine_greedy_parity_multi_chunk():
+    """Greedy decode through the paged engine (chunked prefill crossing
+    page boundaries) is token-identical to the one-shot path, full
+    logprob rows included."""
+    prompts = np.asarray([[3, 7, 11, 2, 9, 4, 1, 8, 5, 2]], np.int32)
+    lengths = np.asarray([10], np.int32)
+    want = generate_tokens(CFG, PARAMS, prompts, lengths, max_new_tokens=8,
+                           temperature=0.0)
+    # chunk 4 < prompt 10 < 2 pages: 3 chunks, page-spanning writes
+    eng = make_paged(prefill_chunk=4)
+    got = eng.generate(prompts, lengths, max_new_tokens=8, temperature=0.0)
+    np.testing.assert_array_equal(got.tokens, want.tokens)
+    np.testing.assert_allclose(got.logprobs, want.logprobs,
+                               rtol=1e-5, atol=1e-5)
+    assert eng.stats["prefill_chunks"] == 3
+    assert eng.stats["decode_recompiles"] == 0
+
+
+def test_paged_engine_ragged_batch_parity():
+    prompts = np.asarray([[3, 7, 11, 2], [5, 0, 0, 0]], np.int32)
+    lengths = np.asarray([4, 1], np.int32)
+    want = generate_tokens(CFG, PARAMS, prompts, lengths, max_new_tokens=6,
+                           temperature=0.0)
+    got = make_paged().generate(prompts, lengths, max_new_tokens=6,
+                                temperature=0.0)
+    np.testing.assert_array_equal(got.tokens, want.tokens)
+    np.testing.assert_array_equal(got.lengths, want.lengths)
+    np.testing.assert_allclose(got.logprobs, want.logprobs,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_paged_engine_int8_cache_parity():
+    """int8 paged pools (quantize-on-write through the page table) match
+    the one-shot int8 path."""
+    prompts = np.asarray([[3, 7, 11, 2]], np.int32)
+    lengths = np.asarray([4], np.int32)
+    want = generate_tokens(CFG, PARAMS, prompts, lengths, max_new_tokens=6,
+                           temperature=0.0, kv_cache_int8=True)
+    got = make_paged(kv_cache_int8=True).generate(
+        prompts, lengths, max_new_tokens=6, temperature=0.0)
+    np.testing.assert_array_equal(got.tokens, want.tokens)
+
+
+def test_paged_prefix_cache_hit_parity():
+    """A request sharing another's prompt prefix aliases its pages, skips
+    the shared prefill span, and still produces identical tokens AND
+    teacher-forced prompt logprobs."""
+    rng = np.random.default_rng(3)
+    shared = rng.integers(1, 60, 16).astype(np.int32)
+    p1 = np.concatenate([shared, [7, 3]]).astype(np.int32)
+    p2 = np.concatenate([shared, [9, 5, 2]]).astype(np.int32)
+
+    def run(eng, prompt):
+        r = eng.submit(Request(prompt=prompt, max_new_tokens=6))
+        eng.run_until_idle()
+        assert r.error is None, r.error
+        return r
+
+    slot = make_engine()
+    paged = make_paged()
+    for prompt in (p1, p2):
+        a, b = run(slot, prompt), run(paged, prompt)
+        assert a.generated == b.generated
+        np.testing.assert_allclose(a.prompt_logprobs, b.prompt_logprobs,
+                                   rtol=1e-5, atol=1e-5)
+    # p2 aliased p1's two full prefix pages: 16 shared tokens -> only the
+    # boundary token + suffix recomputed (15 positions skipped)
+    assert paged.stats["prefix_hits"] == 1
+    assert paged.stats["prefix_tokens_saved"] == 15
+    assert paged.stats["decode_recompiles"] == 0
+
+
+def test_paged_preemption_midstream_parity():
+    """Under page-pool pressure the youngest request is preempted
+    mid-stream and later resumed by teacher-forced recompute — both
+    requests still finish token-identical to uncontended runs (greedy
+    AND sampled: the preserved PRNG chain must resume exactly)."""
+    pa = np.asarray([3, 7, 11, 2, 9, 4], np.int32)
+    pb = np.asarray([5, 8, 1, 6, 2, 7], np.int32)
+    kw = dict(num_slots=2, max_seq_len=32, page_size=4, prefill_chunk=8)
+    sampled = dict(temperature=0.7, top_k=8, seed=5)
+
+    def solo(prompt, **skw):
+        eng = make_paged(**kw)
+        r = eng.submit(Request(prompt=prompt, max_new_tokens=16, **skw))
+        eng.run_until_idle()
+        assert r.error is None, r.error
+        return r
+
+    a_solo, b_solo = solo(pa), solo(pb, **sampled)
+
+    # 9 usable pages can't hold both sequences at full length (6 pages
+    # each): B (younger) gets preempted, A finishes, B resumes
+    eng = make_paged(num_pages=10, **kw)
+    ra = eng.submit(Request(prompt=pa, max_new_tokens=16))
+    rb = eng.submit(Request(prompt=pb, max_new_tokens=16, **sampled))
+    eng.run_until_idle()
+    assert ra.error is None and rb.error is None, (ra.error, rb.error)
+    assert eng.stats["preemptions"] >= 1
+    assert ra.generated == a_solo.generated
+    assert rb.generated == b_solo.generated
+    np.testing.assert_allclose(rb.prompt_logprobs, b_solo.prompt_logprobs,
+                               rtol=1e-5, atol=1e-5)
+    assert eng.stats["decode_recompiles"] == 0
+    # every page accounted for after the drain: slots released theirs,
+    # only the radix tree still holds cached prefixes
+    assert eng.pool.used_pages == len(eng.prefix_cache)
+
+
+@pytest.mark.slow  # ~15s measured cacheless (mirrors the slot engine's
+# interleaved test); greedy/int8/prefix/preemption parity stay tier-1
+def test_paged_interleaved_traffic_parity():
+    """Paged engine: a request's tokens must not change when other slots
+    are active — greedy AND sampled (per-slot PRNG chains survive the
+    page-table indirection)."""
+    promptA = np.asarray([3, 7, 11], np.int32)
+    sampledB = dict(prompt=np.asarray([5], np.int32), max_new_tokens=16,
+                    temperature=0.8, top_k=5, seed=7)
+
+    eng = make_paged()
+    a_solo = eng.submit(Request(prompt=promptA, max_new_tokens=10))
+    eng.run_until_idle()
+    eng = make_paged()
+    b_solo = eng.submit(Request(**sampledB))
+    eng.run_until_idle()
+
+    eng = make_paged()
+    b_mix = eng.submit(Request(**sampledB))
+    eng.step()
+    eng.step()
+    eng.step()
+    a_mix = eng.submit(Request(prompt=promptA, max_new_tokens=10))
+    c = eng.submit(Request(prompt=np.asarray([9, 2], np.int32),
+                           max_new_tokens=5, temperature=1.2, top_p=0.9,
+                           seed=3))
+    eng.run_until_idle()
+
+    assert a_mix.generated == a_solo.generated
+    assert b_mix.generated == b_solo.generated
+    assert c.done.is_set() and len(c.generated) == 5
+
+
+def test_paged_chunked_prefill_interleaves_with_decode():
+    """A long prompt enters the cache one chunk per tick while an active
+    request keeps decoding — chunked prefill can't stall the batch."""
+    eng = make_paged(prefill_chunk=4, max_seq_len=64)
+    a = eng.submit(Request(prompt=np.asarray([3, 7], np.int32),
+                           max_new_tokens=20))
+    # admit A and give it a couple of ticks
+    eng.step()
+    eng.step()
+    done_before = len(a.generated)
+    long_prompt = np.arange(1, 25, dtype=np.int32)  # 24 tokens = 6 chunks
+    b = eng.submit(Request(prompt=long_prompt, max_new_tokens=2))
+    progressed = 0
+    while b.first_token_time is None and not b.done.is_set():
+        before = len(a.generated)
+        eng.step()
+        progressed += int(len(a.generated) > before)
+    # A kept generating during B's multi-tick prefill
+    assert progressed >= 4, (progressed, len(a.generated), done_before)
+    eng.run_until_idle()
+    assert a.error is None and b.error is None
+    assert len(a.generated) == 20 and len(b.generated) == 2
+    assert eng.stats["prefill_chunks"] >= 7
+
+
+# ---------------------------------------------------------------------------
+# satellite: max_seq_len rounding (the silent flash-decode fallback fix)
+
+
+def test_engine_max_seq_len_rounds_to_kernel_multiple(monkeypatch):
+    """When the TPU kernel path is active, a max_seq_len not divisible by
+    128 is rounded UP (with a warning) instead of silently running the
+    dense fallback every tick."""
+    monkeypatch.setattr(InferenceEngine, "_kernel_seq_multiple",
+                        lambda self: 128)
+    with pytest.warns(UserWarning, match="rounding"):
+        eng = make_engine(max_seq_len=200)
+    assert eng.max_seq_len == 256
+    # oversized-request validation uses the rounded value
+    r = eng.submit(Request(prompt=np.asarray([1] * 250, np.int32),
+                           max_new_tokens=10))
+    assert r.error and "256" in r.error
+
+
+def test_engine_max_seq_len_no_rounding_on_cpu():
+    """CPU hosts interpret the kernel: no constraint, no warning."""
+    import warnings as w
+
+    with w.catch_warnings():
+        w.simplefilter("error")
+        eng = make_engine(max_seq_len=100)
+    assert eng.max_seq_len == 100
+
+
+def test_paged_engine_rounds_to_page_multiple():
+    with pytest.warns(UserWarning, match="rounding"):
+        eng = make_paged(max_seq_len=60, page_size=8)
+    assert eng.max_seq_len == 64 and eng.max_pages == 8
+
+
+# ---------------------------------------------------------------------------
+# satellite: bounded admission (--serve_max_queue)
+
+
+def test_engine_max_queue_rejects_overload():
+    """Beyond max_queue waiting requests, submit() rejects instead of
+    queueing — overload degrades to fast 503s upstream, not unbounded
+    latency."""
+    eng = _fake_steps(make_engine(num_slots=1, max_queue=2))
+    held = [eng.submit(Request(prompt=np.asarray([1], np.int32),
+                               max_new_tokens=3)) for _ in range(2)]
+    rejected = eng.submit(Request(prompt=np.asarray([2], np.int32),
+                                  max_new_tokens=3))
+    assert rejected.done.is_set() and rejected.overloaded
+    assert "queue full" in rejected.error
+    assert eng.stats["rejected"] == 1
+    eng.run_until_idle()
+    for r in held:
+        assert r.error is None and len(r.generated) == 3
+
+    # the batch API surfaces overload as EngineOverloadedError
+    eng2 = _fake_steps(make_engine(num_slots=1, max_queue=1))
+    with eng2._cv:
+        eng2._queue.append(Request(prompt=np.asarray([1], np.int32),
+                                   max_new_tokens=1))
+    with pytest.raises(EngineOverloadedError):
+        eng2.generate(np.asarray([[1]], np.int32), np.asarray([1]),
+                      max_new_tokens=1)
+
+
+def test_server_replies_503_with_retry_after_when_queue_full():
+    """HTTP face of --serve_max_queue: overload answers 503 + Retry-After
+    (fake-stepped engine: scheduler logic only, no compiles)."""
+    from megatron_tpu.inference.server import GenerationService, make_handler
+    from megatron_tpu.telemetry.metrics import MetricsRegistry
+
+    tok = NullTokenizer(64)
+    service = GenerationService(CFG, PARAMS, tok, engine_slots=1,
+                                engine_max_queue=1,
+                                metrics=MetricsRegistry())
+    eng = _fake_steps(service.engine)
+    fast_decode = eng._decode_step
+
+    def slow_decode(*a):
+        time.sleep(0.02)
+        return fast_decode(*a)
+
+    eng._decode_step = slow_decode
+    server = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(service))
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+
+    def fire(n_toks, results):
+        body = json.dumps({"prompts": ["3 7"],
+                           "tokens_to_generate": n_toks}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api", data=body, method="PUT",
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                results.append((resp.status, dict(resp.headers)))
+        except urllib.error.HTTPError as e:
+            results.append((e.code, dict(e.headers)))
+
+    try:
+        import urllib.error
+
+        held = []
+        t1 = threading.Thread(target=fire, args=(50, held))
+        t1.start()  # occupies the single slot for ~1s of slow ticks
+        deadline = time.monotonic() + 30
+        while eng.stats["admitted"] == 0:
+            assert time.monotonic() < deadline, "request never admitted"
+            time.sleep(0.005)
+        t2 = threading.Thread(target=fire, args=(50, held))
+        t2.start()  # waits in the queue (now at max_queue=1)
+        while not eng._queue:
+            assert time.monotonic() < deadline, "request never queued"
+            time.sleep(0.005)
+        overload = []
+        fire(5, overload)  # third concurrent request: queue full
+        assert overload and overload[0][0] == 503, overload
+        assert "Retry-After" in overload[0][1], overload[0][1]
+        assert eng.stats["rejected"] >= 1
+        t1.join(timeout=60)
+        t2.join(timeout=60)
+        assert [s for s, _ in held] == [200, 200], held
+    finally:
+        server.shutdown()
+        service.shutdown()
 
 
 # ---------------------------------------------------------------------------

@@ -35,6 +35,30 @@ def extra_args(parser):
                         "would OOM at startup where the old per-request "
                         "server booted). Raise it to serve longer "
                         "prompt+generation budgets")
+    g.add_argument("--serve_kv_paging", action="store_true",
+                   help="paged KV cache: one shared page pool + radix "
+                        "prefix cache + chunked prefill instead of "
+                        "per-slot cache rows (docs/serving.md) — shared "
+                        "prompt prefixes skip prefill and long prompts "
+                        "can't stall the decode batch")
+    g.add_argument("--serve_page_size", type=int, default=16,
+                   help="tokens per KV page (paged mode); multiples of 8 "
+                        "keep the TPU paged flash-decode kernel usable")
+    g.add_argument("--serve_prefill_chunk", type=int, default=32,
+                   help="prompt tokens prefilled per engine tick (paged "
+                        "mode): chunked prefill interleaves with decode "
+                        "so one long prompt never stalls the batch")
+    g.add_argument("--serve_num_pages", type=int, default=None,
+                   help="KV pool size in pages (paged mode; default = "
+                        "slots x pages-per-sequence, i.e. the slot "
+                        "engine's capacity). Smaller oversubscribes: the "
+                        "engine evicts cached prefixes and preempts the "
+                        "youngest request under pressure")
+    g.add_argument("--serve_max_queue", type=int, default=None,
+                   help="bound the engine admission queue: requests "
+                        "beyond this many waiters get HTTP 503 + "
+                        "Retry-After instead of unbounded queue latency "
+                        "(default: unbounded)")
     g.add_argument("--kv_cache_int8", action="store_true",
                    help="serve with an int8-quantized KV cache (half the "
                         "cache HBM -> 2x context/batch per chip)")
@@ -130,17 +154,32 @@ def main(argv=None):
         engine_max_seq_len = min(cfg.model.seq_length, 2048)
     if engine_slots:
         m = cfg.model
-        gib = (2 * m.num_layers * engine_slots * engine_max_seq_len
-               * m.n_kv_heads * m.head_dim
-               * (1 if args.kv_cache_int8 else 2)) / 2**30
-        print(f"persistent KV cache: {engine_slots} slots x "
-              f"{engine_max_seq_len} tokens = {gib:.2f} GiB"
-              + (" (int8)" if args.kv_cache_int8 else " (bf16)"))
+        bpe = 1 if args.kv_cache_int8 else 2
+        if args.serve_kv_paging:
+            ps = args.serve_page_size
+            pages = (args.serve_num_pages
+                     or engine_slots * (-(-engine_max_seq_len // ps)) + 1)
+            gib = (2 * m.num_layers * pages * ps * m.n_kv_heads
+                   * m.head_dim * bpe) / 2**30
+            print(f"paged KV pool: {pages} pages x {ps} tokens = "
+                  f"{gib:.2f} GiB"
+                  + (" (int8)" if args.kv_cache_int8 else " (bf16)"))
+        else:
+            gib = (2 * m.num_layers * engine_slots * engine_max_seq_len
+                   * m.n_kv_heads * m.head_dim * bpe) / 2**30
+            print(f"persistent KV cache: {engine_slots} slots x "
+                  f"{engine_max_seq_len} tokens = {gib:.2f} GiB"
+                  + (" (int8)" if args.kv_cache_int8 else " (bf16)"))
     run_server(cfg.model, params, tokenizer, host=args.host, port=args.port,
                mesh=mesh, forward_fn=forward_fn,
                kv_cache_int8=args.kv_cache_int8,
                engine_slots=engine_slots,
-               engine_max_seq_len=engine_max_seq_len)
+               engine_max_seq_len=engine_max_seq_len,
+               engine_max_queue=args.serve_max_queue,
+               kv_paging=args.serve_kv_paging,
+               page_size=args.serve_page_size,
+               prefill_chunk=args.serve_prefill_chunk,
+               num_pages=args.serve_num_pages)
 
 
 if __name__ == "__main__":
