@@ -547,6 +547,101 @@ def serve_prefix_cache_bench(deadline, num_requests=8, shared_len=64,
     return line
 
 
+def serve_slo_bench(deadline, num_replicas=2, engine_slots=2,
+                    num_requests=18, offered_rps=3.0, new_tokens=8):
+    """Offered-load SLO replay through the fleet router
+    (inference/fleet/): an in-process fleet of `num_replicas` replica
+    servers behind a RouterServer receives a deterministic open-loop
+    trace at `offered_rps` (tools/slo_harness.py inlined), and the line
+    reports TTFT/TPOT p50/p95/p99 scraped off the engines' Prometheus
+    histograms (diffed around the window, so warmup compiles fall out).
+    value = achieved completed-requests/s; vs_baseline = achieved/offered
+    (1.0 = the fleet keeps up with the offered load; every request must
+    complete — a lost request zeroes the line). Tiny deterministic
+    geometry on every backend: this measures the control plane's latency
+    distribution under load, not model throughput (the throughput story
+    is serve_decode_throughput_toks_per_s)."""
+    line = {"metric": "serve_slo_offered_load", "value": 0.0,
+            "unit": "requests_per_sec", "vs_baseline": 0.0}
+    if deadline - time.perf_counter() < 60:
+        line["error"] = "budget_exhausted"
+        return line
+    services, servers, threads = [], [], []
+    router = None
+    try:
+        import threading
+        from http.server import ThreadingHTTPServer
+
+        import jax
+
+        from megatron_tpu.inference.fleet import slo
+        from megatron_tpu.inference.fleet.router import RouterServer
+        from megatron_tpu.inference.server import (
+            GenerationService, make_handler,
+        )
+        from megatron_tpu.models import presets
+        from megatron_tpu.models.params import init_params
+        from megatron_tpu.telemetry.metrics import MetricsRegistry
+        from megatron_tpu.tokenizer.tokenizer import NullTokenizer
+
+        cfg = presets.tiny(vocab_size=64, seq_length=64)
+        tok = NullTokenizer(cfg.vocab_size - 1)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        urls = []
+        for _ in range(num_replicas):
+            # per-replica registries: shared default_registry would merge
+            # both engines' histograms before the scrape even runs
+            # warmup=True defers the warmed flag so svc.warmup() below
+            # actually compiles (with the default it's a no-op and the
+            # jit compile would land INSIDE the measured SLO window)
+            svc = GenerationService(cfg, params, tok,
+                                    engine_slots=engine_slots,
+                                    engine_max_seq_len=64,
+                                    metrics=MetricsRegistry(),
+                                    warmup=True)
+            srv = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(svc))
+            th = threading.Thread(target=srv.serve_forever, daemon=True)
+            th.start()
+            svc.warmup()
+            services.append(svc)
+            servers.append(srv)
+            threads.append(th)
+            urls.append(f"http://127.0.0.1:{srv.server_address[1]}")
+        router = RouterServer(urls).start()
+        trace = slo.make_trace(num_requests, offered_rps,
+                               vocab=cfg.vocab_size, new_tokens=new_tokens)
+        report = slo.run_slo(router.url + "/api",
+                             [u + "/metrics" for u in urls], trace,
+                             offered_rps, timeout=60.0)
+        value = report["achieved_rps"] if report["failed"] == 0 else 0.0
+        line.update(
+            value=value,
+            vs_baseline=round(value / offered_rps, 3),
+            detail={
+                "num_replicas": num_replicas,
+                "engine_slots": engine_slots,
+                "requests": report["requests"],
+                "completed": report["completed"],
+                "failed": report["failed"],
+                "ttft_s": report["ttft_s"],
+                "tpot_s": report["tpot_s"],
+                "client_wall_s": report["client_wall_s"],
+                "new_tokens": new_tokens,
+                "hidden": cfg.hidden_size, "layers": cfg.num_layers,
+            })
+    except Exception as e:  # noqa: BLE001 - the metric line must emit
+        line["error"] = str(e)[:300]
+    finally:
+        if router is not None:
+            router.close()
+        for srv in servers:
+            srv.shutdown()
+            srv.server_close()
+        for svc in services:
+            svc.shutdown()
+    return line
+
+
 def async_loop_bench(deadline, stall_ms=20.0, iters=14, skip_gaps=2):
     """Async-goodput-loop micro-bench (ISSUE 5 acceptance; CPU-able): a
     tiny TrainLoop is fed an iterator with an injected stall_ms host stall
@@ -798,6 +893,7 @@ def main():
         # the multi-minute training-step search. Never set by the driver.
         print(json.dumps(serving_engine_bench(deadline)), flush=True)
         print(json.dumps(serve_prefix_cache_bench(deadline)), flush=True)
+        print(json.dumps(serve_slo_bench(deadline)), flush=True)
         return
 
     from megatron_tpu.models.params import num_params
@@ -930,6 +1026,7 @@ def main():
             print(json.dumps(serving_engine_bench(deadline)), flush=True)
             print(json.dumps(serve_prefix_cache_bench(deadline)),
                   flush=True)
+            print(json.dumps(serve_slo_bench(deadline)), flush=True)
         if want_extras:
             run_extras(deadline, peak, extras)
 

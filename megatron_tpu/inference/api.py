@@ -57,13 +57,17 @@ def generate_and_post_process(
     forward_fn=None,
     kv_cache_int8: bool = False,
     engine=None,
+    deadline_s=None,
 ):
     """(texts, segments, logprobs, tokens) like the reference's
     generate_and_post_process (api.py:19-90). forward_fn plugs in the
     pipelined pp>1 forward (inference/pipelined.py); engine routes the
     request through a continuous-batching InferenceEngine
     (inference/engine.py) instead of the one-shot generate_tokens — its
-    slot scheduler lets concurrent callers share decode steps."""
+    slot scheduler lets concurrent callers share decode steps.
+    deadline_s (engine path only) bounds each request's total wall time:
+    past it the engine fails the request with RequestTimeoutError
+    (HTTP 504) instead of leaving the caller waiting."""
     if tokens_to_generate < 0:
         raise ValueError("tokens_to_generate must be >= 0")
     prompt_tokens, lengths = tokenize_prompts(tokenizer, prompts,
@@ -89,7 +93,8 @@ def generate_and_post_process(
         out = engine.generate(
             prompt_tokens, lengths, max_new_tokens=tokens_to_generate,
             temperature=temperature, top_k=top_k_sampling,
-            top_p=top_p_sampling, eod=tokenizer.eod, seed=random_seed)
+            top_p=top_p_sampling, eod=tokenizer.eod, seed=random_seed,
+            deadline_s=deadline_s)
     else:
         out = generate_tokens(
             cfg, params, prompt_tokens, lengths,

@@ -41,7 +41,9 @@ import numpy as np
 from megatron_tpu.config import ModelConfig
 from megatron_tpu.inference.generation import GenerationOutput, _init_caches
 from megatron_tpu.inference.sampling import sample_logits_batched
+from megatron_tpu.telemetry import journal as _journal
 from megatron_tpu.telemetry.metrics import MetricsRegistry, default_registry
+from megatron_tpu.training import resilience
 
 #: flash_decode (ops/pallas/flash_decode.py) requires the cache length
 #: divisible by this; engines round max_seq_len UP to it on the TPU
@@ -55,6 +57,12 @@ class EngineOverloadedError(RuntimeError):
     rejected, not queued. HTTP serving maps this to 503 + Retry-After."""
 
 
+class RequestTimeoutError(RuntimeError):
+    """A request's deadline expired while it was queued or mid-decode.
+    HTTP serving maps this to 504 Gateway Timeout; the fleet router treats
+    it as non-retryable (the client's budget is spent either way)."""
+
+
 @dataclasses.dataclass
 class Request:
     """One sequence's lifecycle through the engine."""
@@ -65,6 +73,12 @@ class Request:
     top_p: float = 0.0
     eod: Optional[int] = None
     seed: int = 0
+    # relative deadline: seconds after submit() by which the request must
+    # COMPLETE. A queued or mid-decode request past it fails with
+    # timed_out=True (HTTP 504) — waiters on done.wait() are signalled in
+    # bounded time instead of waiting on an abandoned request forever,
+    # which also bounds the router's retry worst case. None = no deadline.
+    deadline_s: Optional[float] = None
     # engine-filled
     generated: List[int] = dataclasses.field(default_factory=list)
     logprobs: List[float] = dataclasses.field(default_factory=list)
@@ -74,6 +88,10 @@ class Request:
     resume_key: Optional[np.ndarray] = None
     # queue-overload rejection marker (submit with max_queue exceeded)
     overloaded: bool = False
+    # deadline-expiry marker (engine-set; error carries the detail)
+    timed_out: bool = False
+    # absolute monotonic deadline (engine-stamped at submit)
+    _deadline: Optional[float] = None
     # teacher-forced logprobs of prompt[1:] from the admission prefill
     # (the one-shot path returns these too; generation.py:136-141)
     prompt_logprobs: List[float] = dataclasses.field(default_factory=list)
@@ -138,6 +156,13 @@ class InferenceEngine:
         self.want_logprobs = want_logprobs
 
         N = num_slots
+        # committed placement for params as well as caches: random-init
+        # params (tests, bench) are UNCOMMITTED jit outputs while
+        # checkpoint-loaded and hot-reloaded params (update_params) are
+        # committed device_puts — without this, the first weight swap on a
+        # random-init engine would split the decode step's jit cache key
+        # and pay one recompile (the smoke test caught exactly that)
+        self.params = self._commit(self.params)
         self.caches = self._commit(self._fresh_caches())
         self.slots: List[Optional[Request]] = [None] * N
         self.lengths = np.zeros(N, np.int32)    # valid context per slot
@@ -156,6 +181,19 @@ class InferenceEngine:
         # of re-uploading 6 host arrays per token; admission events
         # invalidate it (None -> re-upload from the host mirrors)
         self._carry = None
+        # hot weight reload: (params, version, applied_event) staged by
+        # update_params(), swapped in BETWEEN decode ticks by the step
+        # loop so in-flight slots never see a mid-tick change
+        self._pending_params: Optional[tuple] = None
+        self.params_version: Optional[Any] = None
+        # admissions popped from the queue but not yet landed in a slot —
+        # wait_idle() must not report idle while one is mid-prefill
+        self._admitting = 0
+        # last time the engine demonstrably made progress (an admission
+        # or decode tick COMPLETED) — readiness uses stalled() to catch a
+        # wedged step loop, the failure liveness can't see (the thread is
+        # alive, just hung inside a device call)
+        self.last_progress_time = time.monotonic()
 
         self._decode_step = self._build_decode_step()
         self._prefill_steps = {}  # bucketed prompt length -> jitted fn
@@ -164,7 +202,8 @@ class InferenceEngine:
         # one — the "zero recompiles after warmup" invariant (PR 1) as a
         # runtime counter instead of a bench footnote
         self.stats = {"admitted": 0, "retired": 0, "ticks": 0,
-                      "rejected": 0, "decode_recompiles": 0}
+                      "rejected": 0, "decode_recompiles": 0,
+                      "timeouts": 0, "weight_reloads": 0}
         self._decode_cache_seen = 0  # compiles observed on _decode_step
 
         # Prometheus collectors (megatron_tpu/telemetry): shared with the
@@ -186,6 +225,12 @@ class InferenceEngine:
         self._m_rejected = m.counter("engine_requests_rejected_total",
                                      "requests rejected (invalid/oversized/"
                                      "failed prefill/queue full)")
+        self._m_timeouts = m.counter(
+            "engine_requests_timeout_total",
+            "requests failed on an expired deadline (queued or mid-decode)")
+        self._m_reloads = m.counter(
+            "engine_weight_reloads_total",
+            "hot weight swaps applied between decode ticks")
         self._m_ticks = m.counter("engine_ticks_total",
                                   "batched decode steps executed")
         self._m_tokens = m.counter("engine_tokens_generated_total",
@@ -366,6 +411,22 @@ class InferenceEngine:
             self.stats["rejected"] += 1
             self._m_rejected.inc()
             return req
+        if req.deadline_s is not None:
+            if req.deadline_s <= 0:
+                req._finish("deadline_s must be > 0 (or None: no deadline)")
+                self.stats["rejected"] += 1
+                self._m_rejected.inc()
+                return req
+            req._deadline = req.submit_time + req.deadline_s
+        if resilience.fault_armed("reject_admission"):
+            # injected overload: every admission answers queue-full while
+            # armed (drives the router's retry-on-503 path in tests)
+            req.overloaded = True
+            req._finish("engine queue full (injected: reject_admission); "
+                        "retry later")
+            self.stats["rejected"] += 1
+            self._m_rejected.inc()
+            return req
         with self._cv:
             if (self.max_queue is not None
                     and len(self._queue) >= self.max_queue):
@@ -419,6 +480,7 @@ class InferenceEngine:
         # batched sampler's lax.cond filter branch (the [N, V] sort) live
         # for every remaining tick
         self._sync_carry()
+        self._journal_request(req, "ok")
         req._finish()
 
     def _sync_carry(self):
@@ -439,65 +501,77 @@ class InferenceEngine:
                 continue
             with self._cv:
                 req = self._queue.popleft() if self._queue else None
+                if req is not None:
+                    # visible to wait_idle(): popped but not yet in a slot
+                    self._admitting += 1
             if req is None:
                 break
-            self._sync_carry()
-            p = len(req.prompt)
-            P = self._bucket(p)
-            toks = np.zeros((1, P), np.int32)
-            toks[0, :p] = req.prompt
-            t_prefill = time.monotonic()
             try:
-                tok, lp, plp, caches, key = self._prefill_step(P)(
-                    self.params, self.caches, jnp.asarray(toks),
-                    jnp.int32(p), jnp.int32(i), jax.random.PRNGKey(req.seed),
-                    jnp.float32(req.temperature), jnp.int32(req.top_k),
-                    jnp.float32(req.top_p))
-            except Exception as e:  # noqa: BLE001 - a failing prefill
-                # (fresh-bucket compile OOM etc.) must fail THIS request,
-                # not strand it un-signalled and kill the step loop
-                req._finish(f"prefill failed: {e}")
-                self.stats["rejected"] += 1
-                self._m_rejected.inc()
-                if self._donate():
-                    # the failed call may have consumed the donated cache
-                    # buffers — continuing would poison every active slot
-                    # at the next decode tick (step() has the matching
-                    # recovery); fail the in-flight requests and restart
-                    # from a fresh cache
-                    for j, other in enumerate(self.slots):
-                        if other is not None:
-                            self._clear_slot(j)
-                            other._finish(f"prefill failed: {e}")
-                    self.caches = self._commit(self._fresh_caches())
-                    self._m_active.set(self.num_active)
-                continue
-            self.caches = caches
-            self.slots[i] = req
-            self.lengths[i] = p
-            self.last_tok[i] = int(tok)
-            self.temps[i] = req.temperature
-            self.top_ks[i] = req.top_k
-            self.top_ps[i] = req.top_p
-            self.keys[i] = np.asarray(key)
-            req.generated.append(int(tok))
-            req.logprobs.append(float(lp))
-            req.prompt_logprobs = [float(x) for x in plp[:p - 1]]
-            self.stats["admitted"] += 1
-            now = time.monotonic()
-            req.first_token_time = now
-            self._m_prefill.observe(now - t_prefill)
-            if req.submit_time is not None:
-                self._m_ttft.observe(now - req.submit_time)
-            self._m_admitted.inc()
-            self._m_tokens.inc()
-            self._m_active.set(self.num_active)
-            with self._cv:
-                self._m_queue.set(len(self._queue))
-            n += 1
-            if self._req_finished(req):
-                self._retire(i)
+                n += self._admit_one(i, req)
+            finally:
+                with self._cv:
+                    self._admitting -= 1
+                self.last_progress_time = time.monotonic()
         return n
+
+    def _admit_one(self, i: int, req: Request) -> int:
+        """Prefill `req` into free slot `i`; returns 1 if admitted."""
+        self._sync_carry()
+        p = len(req.prompt)
+        P = self._bucket(p)
+        toks = np.zeros((1, P), np.int32)
+        toks[0, :p] = req.prompt
+        t_prefill = time.monotonic()
+        try:
+            tok, lp, plp, caches, key = self._prefill_step(P)(
+                self.params, self.caches, jnp.asarray(toks),
+                jnp.int32(p), jnp.int32(i), jax.random.PRNGKey(req.seed),
+                jnp.float32(req.temperature), jnp.int32(req.top_k),
+                jnp.float32(req.top_p))
+        except Exception as e:  # noqa: BLE001 - a failing prefill
+            # (fresh-bucket compile OOM etc.) must fail THIS request,
+            # not strand it un-signalled and kill the step loop
+            req._finish(f"prefill failed: {e}")
+            self.stats["rejected"] += 1
+            self._m_rejected.inc()
+            if self._donate():
+                # the failed call may have consumed the donated cache
+                # buffers — continuing would poison every active slot
+                # at the next decode tick (step() has the matching
+                # recovery); fail the in-flight requests and restart
+                # from a fresh cache
+                for j, other in enumerate(self.slots):
+                    if other is not None:
+                        self._clear_slot(j)
+                        other._finish(f"prefill failed: {e}")
+                self.caches = self._commit(self._fresh_caches())
+                self._m_active.set(self.num_active)
+            return 0
+        self.caches = caches
+        self.slots[i] = req
+        self.lengths[i] = p
+        self.last_tok[i] = int(tok)
+        self.temps[i] = req.temperature
+        self.top_ks[i] = req.top_k
+        self.top_ps[i] = req.top_p
+        self.keys[i] = np.asarray(key)
+        req.generated.append(int(tok))
+        req.logprobs.append(float(lp))
+        req.prompt_logprobs = [float(x) for x in plp[:p - 1]]
+        self.stats["admitted"] += 1
+        now = time.monotonic()
+        req.first_token_time = now
+        self._m_prefill.observe(now - t_prefill)
+        if req.submit_time is not None:
+            self._m_ttft.observe(now - req.submit_time)
+        self._m_admitted.inc()
+        self._m_tokens.inc()
+        self._m_active.set(self.num_active)
+        with self._cv:
+            self._m_queue.set(len(self._queue))
+        if self._req_finished(req):
+            self._retire(i)
+        return 1
 
     def _req_finished(self, req: Request) -> bool:
         return (len(req.generated) >= req.max_new_tokens
@@ -508,8 +582,151 @@ class InferenceEngine:
         """One engine tick: admit into free slots, then one batched decode
         for every active slot. Returns the number of active slots served
         (0 = idle)."""
+        self._pre_tick()
         self._admit()
         return self._decode_tick()
+
+    def _pre_tick(self) -> None:
+        """Per-tick control-plane work shared by every engine subclass
+        (the paged engine overrides step() and MUST call this first):
+        serving fault injection (MEGATRON_TPU_FAULT, tick-indexed — a
+        SIGKILLed/hung/slowed replica at a deterministic decode tick, so
+        the router's failover paths are testable on CPU), staged weight
+        swaps, and deadline expiry."""
+        tick = self.stats["ticks"]
+        resilience.maybe_kill("kill_replica", tick)
+        resilience.maybe_hang("hang_replica", tick)
+        resilience.maybe_sleep("slow_tick", journal_once=True)
+        self._apply_pending_params()
+        self._expire_deadlines()
+
+    # ----- hot weight reload ----------------------------------------------
+
+    def update_params(self, params: Any, version: Any = None
+                      ) -> threading.Event:
+        """Stage a weight swap; the step loop applies it BETWEEN decode
+        ticks, so in-flight slots keep decoding without interruption (their
+        KV prefixes were computed by the old weights — a drained rolling
+        update keeps per-request token identity; docs/serving.md).
+
+        The new tree must match the old one in structure/shape/dtype and is
+        committed with the same placement policy as __init__, so the jitted
+        decode step's cache key is unchanged — a swap costs ZERO recompiles
+        (the live decode_recompiles counter is the regression gate).
+
+        Returns an Event set once the swap has been applied."""
+        def check(old, new):
+            if (old.shape, old.dtype) != (new.shape, new.dtype):
+                raise ValueError(
+                    f"update_params shape/dtype mismatch: {old.shape}/"
+                    f"{old.dtype} vs {new.shape}/{new.dtype} — a "
+                    "mismatched tree would recompile (or garble) the "
+                    "decode step")
+
+        jax.tree.map(check, self.params, params)
+        applied = threading.Event()
+        committed = self._commit(params)
+        with self._cv:
+            if self._pending_params is not None:
+                # a staged-but-unapplied swap is superseded; its waiter
+                # unblocks too (the newer weights subsume the older ones)
+                self._pending_params[2].set()
+            self._pending_params = (committed, version, applied)
+            self._cv.notify_all()
+        return applied
+
+    def _apply_pending_params(self) -> None:
+        with self._cv:
+            pending = self._pending_params
+            self._pending_params = None
+        if pending is None:
+            return
+        new, version, applied = pending
+        self.params = new
+        self.params_version = version
+        self.stats["weight_reloads"] += 1
+        self._m_reloads.inc()
+        j = _journal.get_global_journal()
+        if j is not None:
+            j.emit("weight_reload", version=version,
+                   active=self.num_active)
+        applied.set()
+
+    # ----- deadlines -------------------------------------------------------
+
+    def _expire_deadlines(self) -> None:
+        """Fail queued and mid-decode requests past their deadline: their
+        waiters unblock with timed_out=True within one tick of expiry
+        instead of waiting on an abandoned request forever."""
+        now = time.monotonic()
+        expired = []
+        with self._cv:
+            for req in [r for r in self._queue
+                        if r._deadline is not None and now > r._deadline]:
+                self._queue.remove(req)
+                expired.append(req)
+            if expired:
+                self._m_queue.set(len(self._queue))
+        for req in expired:
+            self._fail_timeout(req, "queued")
+        for i in range(self.num_slots):
+            req = self.slots[i]
+            if (req is not None and req._deadline is not None
+                    and now > req._deadline):
+                self._clear_slot(i)
+                # same carry hygiene as _retire: the cleared row's sampling
+                # knobs must not keep the batched sampler's filter branch
+                # live for the remaining ticks
+                self._sync_carry()
+                self._m_active.set(self.num_active)
+                self._fail_timeout(req, "mid-decode")
+
+    def _fail_timeout(self, req: Request, where: str) -> None:
+        req.timed_out = True
+        self.stats["timeouts"] += 1
+        self._m_timeouts.inc()
+        self._journal_request(req, "timeout")
+        req._finish(
+            f"deadline exceeded while {where} (deadline_s="
+            f"{req.deadline_s}, generated {len(req.generated)} of "
+            f"{req.max_new_tokens} tokens)")
+
+    def wait_idle(self, timeout: Optional[float] = None) -> bool:
+        """Block until no request is queued, mid-admission, or decoding
+        (and no weight swap is pending). The drain step of a rolling
+        update: stop routing work here, wait_idle, then reload. Returns
+        False if `timeout` seconds pass first."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._cv:
+                if (not self._queue and self._admitting == 0
+                        and self.num_active == 0
+                        and self._pending_params is None):
+                    return True
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            time.sleep(0.01)
+
+    def _journal_request(self, req: Request, status: str) -> None:
+        """Per-request journal record (when a global journal is set):
+        the SLO harness and tools/telemetry_report.py read TTFT/TPOT
+        percentiles and failure counts off these."""
+        j = _journal.get_global_journal()
+        if j is None:
+            return
+        now = time.monotonic()
+        fields = {"status": status, "prompt_len": len(req.prompt),
+                  "new_tokens": len(req.generated)}
+        if req.submit_time is not None:
+            fields["wall_s"] = round(now - req.submit_time, 6)
+            if req.first_token_time is not None:
+                fields["ttft_s"] = round(
+                    req.first_token_time - req.submit_time, 6)
+                if len(req.generated) > 1:
+                    fields["tpot_s"] = round(
+                        (now - req.first_token_time)
+                        / (len(req.generated) - 1), 6)
+        j.emit("serve_request", **fields)
 
     def _decode_rows(self):
         """Slot indices the batched decode serves this tick (the paged
@@ -578,7 +795,19 @@ class InferenceEngine:
             req.logprobs.append(float(lps[i]))
             if self._req_finished(req):
                 self._retire(i)
+        self.last_progress_time = time.monotonic()
         return len(active)
+
+    def stalled(self, threshold_s: float) -> bool:
+        """True when the engine has pending work (active slots or queued
+        requests) but has made no progress for `threshold_s` — the hung-
+        step-loop signal readiness probes use. An IDLE engine is never
+        stalled, however long it sits."""
+        with self._cv:
+            busy = (self.num_active > 0 or bool(self._queue)
+                    or self._admitting > 0)
+        return (busy and
+                time.monotonic() - self.last_progress_time > threshold_s)
 
     def _track_decode_recompiles(self) -> None:
         """Enforce the zero-recompiles-after-warmup invariant as a live
@@ -618,7 +847,8 @@ class InferenceEngine:
     def generate(self, prompts: np.ndarray, lengths: np.ndarray,
                  max_new_tokens: int, temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 0.0,
-                 eod: Optional[int] = None, seed: int = 0
+                 eod: Optional[int] = None, seed: int = 0,
+                 deadline_s: Optional[float] = None
                  ) -> GenerationOutput:
         """Batch convenience with generate_tokens' semantics: submit one
         request per row, drain, and repack [B, maxp+max_new] (rows padded
@@ -652,7 +882,7 @@ class InferenceEngine:
                 reqs.append(self.submit(Request(
                     prompt=np.asarray(prompts[b, :p], np.int32),
                     max_new_tokens=maxp - p + max_new_tokens,
-                    temperature=temperature,
+                    temperature=temperature, deadline_s=deadline_s,
                     top_k=top_k, top_p=top_p, eod=eod, seed=seed + b)))
         if self._thread is None:
             self.run_until_idle()
@@ -661,6 +891,9 @@ class InferenceEngine:
         if any(r.overloaded for r in reqs):
             raise EngineOverloadedError(
                 next(r.error for r in reqs if r.overloaded))
+        if any(r.timed_out for r in reqs):
+            raise RequestTimeoutError(
+                next(r.error for r in reqs if r.timed_out))
         errs = [r.error for r in reqs if r.error]
         if errs:
             raise ValueError(errs[0])
@@ -694,7 +927,8 @@ class InferenceEngine:
                 while True:
                     with self._cv:
                         while (not self._stop and self.num_active == 0
-                               and not self._queue):
+                               and not self._queue
+                               and self._pending_params is None):
                             if self.flight_recorder is not None:
                                 # an IDLE engine is healthy, not hung: keep
                                 # beating (bounded wait) or the watchdog
@@ -750,6 +984,11 @@ class InferenceEngine:
                 req._finish("engine stopped")
         for req in leftovers:
             req._finish("engine stopped")
+        with self._cv:
+            if self._pending_params is not None:
+                # unblock a reload waiter — the swap will never be applied
+                self._pending_params[2].set()
+                self._pending_params = None
         self._carry = None
         self._m_active.set(0)
         self._m_queue.set(0)

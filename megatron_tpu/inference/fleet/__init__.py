@@ -1,0 +1,45 @@
+"""Serving fleet control plane (docs/serving.md "Fleet").
+
+The reference repo's L7 is one Flask process; "heavy traffic from millions
+of users" needs a fleet that survives replica death and ships new weights
+without dropping requests. This package is that control plane, composed
+from machinery earlier PRs built:
+
+  * router.py   — health-aware least-loaded front door over N replicas,
+                  with per-replica circuit breaker, bounded
+                  retry-with-backoff, failover, and rolling weight
+                  updates (drain -> reload -> readmit, one replica at a
+                  time). Dispatch reads the slot/queue Prometheus gauges
+                  and /readyz the replicas already expose (PR 3).
+  * replica.py  — subprocess replica handle + the `python -m
+                  megatron_tpu.inference.fleet.replica` entry point the
+                  chaos tests SIGKILL.
+  * reload.py   — manifest-verified committed-checkpoint param loads
+                  (PR 2's verify_checkpoint machinery) feeding
+                  InferenceEngine.update_params hot swaps.
+  * scrape.py   — minimal Prometheus text-format parsing (gauges +
+                  histogram-bucket percentiles) for the router's prober
+                  and the SLO harness.
+  * slo.py      — offered-load traffic replay reporting TTFT/TPOT
+                  percentiles from the telemetry histograms
+                  (tools/slo_harness.py is the CLI).
+
+Everything here is pure host code — zero new collectives (the golden comm
+manifests are unchanged; tools/comm_report.py --check).
+"""
+
+from megatron_tpu.inference.fleet.reload import (  # noqa: F401
+    load_verified_params, save_params_checkpoint,
+)
+from megatron_tpu.inference.fleet.router import (  # noqa: F401
+    ReplicaRouter, RouterServer,
+)
+from megatron_tpu.inference.fleet.replica import ReplicaProcess  # noqa: F401
+
+__all__ = [
+    "ReplicaRouter",
+    "RouterServer",
+    "ReplicaProcess",
+    "load_verified_params",
+    "save_params_checkpoint",
+]

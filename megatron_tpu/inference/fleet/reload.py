@@ -1,0 +1,81 @@
+"""Manifest-verified weight loads for hot reload (docs/serving.md).
+
+A rolling weight update must never push a torn or bitrotted checkpoint
+into a serving replica: this module is the read-side bridge between PR 2's
+crash-safe checkpoint commits (training/checkpointing.py: manifest commit
+record, verify_checkpoint, list_valid_checkpoints) and the engine's
+between-tick `update_params` swap. A checkpoint is only eligible when its
+manifest verifies; on a garbage tracker or torn newest save the default
+pick falls back to the newest VALID committed iteration, exactly like
+training resume does.
+
+`save_params_checkpoint` is the matching write-side helper for serving
+tools and tests: a params-only checkpoint with the same staging ->
+manifest -> rename commit discipline (and therefore readable by
+`load_params_only`), without materializing a full TrainState.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Any, Optional, Tuple
+
+from megatron_tpu.training import checkpointing as ckpt
+
+
+class NoValidCheckpointError(RuntimeError):
+    """No committed checkpoint in the load dir passes manifest verify."""
+
+
+def resolve_reload_iteration(load: str, iteration: Optional[int] = None,
+                             deep: bool = False) -> int:
+    """The iteration a reload should serve: `iteration` if pinned (it must
+    verify — a pinned-but-corrupt checkpoint is an operator error worth a
+    loud failure, not a silent fallback), else the newest iteration whose
+    manifest verifies."""
+    if iteration is not None:
+        ok, detail = ckpt.verify_checkpoint(
+            ckpt.checkpoint_dir(load, iteration), deep=deep)
+        if not ok:
+            raise NoValidCheckpointError(
+                f"checkpoint iter {iteration} under {load} failed "
+                f"verification: {detail}")
+        return int(iteration)
+    valid = ckpt.list_valid_checkpoints(load, deep=deep)
+    if not valid:
+        raise NoValidCheckpointError(
+            f"no committed checkpoint under {load} passes manifest "
+            "verification")
+    return valid[-1]
+
+
+def load_verified_params(load: str, params_template: Any,
+                         iteration: Optional[int] = None,
+                         deep: bool = False,
+                         shardings=None) -> Tuple[Any, int]:
+    """(params, iteration): manifest-verify then restore just the params
+    subtree (fp32 master copies preferred when present, cast to the
+    template's dtypes — checkpointing.load_params_only)."""
+    it = resolve_reload_iteration(load, iteration, deep=deep)
+    params = ckpt.load_params_only(load, params_template, iteration=it,
+                                   shardings=shardings)
+    return params, it
+
+
+def save_params_checkpoint(save: str, iteration: int, params: Any) -> str:
+    """Commit a params-only checkpoint at `iteration` under `save` with
+    the full atomic discipline: stage -> orbax write -> manifest commit ->
+    rename -> tracker bump. The saved tree is `{"params": ...}`, the shape
+    load_params_only restores (no master subtree: serving saves are
+    already in serving dtype)."""
+    import orbax.checkpoint as ocp
+
+    stage = ckpt._staging_dir(save, iteration)
+    shutil.rmtree(stage, ignore_errors=True)
+    os.makedirs(os.path.dirname(stage), exist_ok=True)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.join(stage, "state"), {"params": params}, force=True)
+    ckptr.wait_until_finished()
+    return ckpt._finalize(save, stage, iteration, consumed_samples=0,
+                          config=None, keep_latest_k=None)

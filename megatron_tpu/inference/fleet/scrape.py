@@ -1,0 +1,161 @@
+"""Minimal Prometheus text-format scraping for the fleet control plane.
+
+The router's prober reads the slot/queue gauges each replica already
+exposes on /metrics (megatron_tpu/telemetry/metrics.py), and the SLO
+harness reads TTFT/TPOT percentiles off the engine histograms — across
+process boundaries, so the in-process Histogram.percentile() helper is out
+of reach and the text exposition is the contract. This parser covers
+exactly what our registry renders (and standard Prometheus clients emit
+compatibly): `name{label="v",...} value` sample lines, `#` comments.
+
+No jax import — the router is pure host code.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import urllib.request
+from typing import Dict, List, Tuple
+
+#: parsed exposition: metric name -> list of (labels, value)
+Samples = Dict[str, List[Tuple[Dict[str, str], float]]]
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)\s*$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_prom_text(text: str) -> Samples:
+    """Parse Prometheus text exposition into {name: [(labels, value)]}."""
+    out: Samples = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        name, labelstr, raw = m.groups()
+        # single-pass unescape: sequential str.replace would corrupt a
+        # literal backslash before 'n' ('\\n' -> newline instead of \n)
+        labels = {k: re.sub(r'\\(["\\n])',
+                            lambda e: "\n" if e.group(1) == "n"
+                            else e.group(1), v)
+                  for k, v in _LABEL_RE.findall(labelstr or "")}
+        try:
+            value = float(raw)
+        except ValueError:
+            continue
+        out.setdefault(name, []).append((labels, value))
+    return out
+
+
+def scrape(url: str, timeout: float = 2.0) -> Samples:
+    """GET a /metrics endpoint and parse it (raises on transport errors —
+    the caller decides what a failed scrape means for health)."""
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return parse_prom_text(resp.read().decode("utf-8", "replace"))
+
+
+def _match(labels: Dict[str, str], want: Dict[str, str]) -> bool:
+    return all(labels.get(k) == v for k, v in want.items())
+
+
+def sample_value(samples: Samples, name: str,
+                 default: float = float("nan"), **labels) -> float:
+    """First sample of `name` matching `labels` (gauges/counters)."""
+    for got, value in samples.get(name, ()):
+        if _match(got, labels):
+            return value
+    return default
+
+
+def histogram_percentile(samples: Samples, name: str, q: float,
+                         **labels) -> float:
+    """q-quantile from `name`'s cumulative `_bucket` series — same
+    upper-bound-of-bucket semantics as the in-process
+    Histogram.percentile(), so a test can assert the two views agree.
+    NaN when the histogram is empty or absent."""
+    buckets: List[Tuple[float, float]] = []  # (le, cumulative count)
+    for got, value in samples.get(f"{name}_bucket", ()):
+        if "le" not in got or not _match(
+                {k: v for k, v in got.items() if k != "le"}, labels):
+            continue
+        le = float("inf") if got["le"] in ("+Inf", "inf") else float(got["le"])
+        buckets.append((le, value))
+    if not buckets:
+        return float("nan")
+    buckets.sort(key=lambda b: b[0])
+    total = buckets[-1][1]
+    if total <= 0:
+        return float("nan")
+    rank = q * total
+    finite = [b for b in buckets if not math.isinf(b[0])]
+    for le, cum in buckets:
+        if cum >= rank:
+            return finite[-1][0] if math.isinf(le) and finite else le
+    return finite[-1][0] if finite else float("nan")
+
+
+def diff_samples(before: Samples, after: Samples) -> Samples:
+    """after - before, per (name, labels): turns cumulative counters and
+    histogram bucket counts into a windowed view, so an SLO report covers
+    exactly the replayed traffic — warmup compiles and earlier traffic
+    fall out of the percentiles. Samples absent from `before` (a replica
+    restarted mid-window, or a metric first observed inside it) keep
+    their `after` value. Meaningless for gauges; callers only diff
+    counters/histograms."""
+    out: Samples = {}
+    for name, rows in after.items():
+        brows = before.get(name, [])
+        out[name] = [
+            (labels,
+             value - next((v for bl, v in brows if bl == labels), 0.0))
+            for labels, value in rows]
+    return out
+
+
+def merge_samples(parts: List[Samples]) -> Samples:
+    """Concatenate scraped expositions (fleet-wide percentiles: bucket
+    series from every replica are SUMMED per `le` by histogram_percentile
+    callers via merge_histograms; plain samples just accumulate)."""
+    out: Samples = {}
+    for p in parts:
+        for name, rows in p.items():
+            out.setdefault(name, []).extend(rows)
+    return out
+
+
+def merged_histogram_percentile(parts: List[Samples], name: str, q: float,
+                                **labels) -> float:
+    """Fleet-wide quantile: sum the cumulative bucket counts per bound
+    across replicas, then take the percentile of the merged histogram."""
+    sums: Dict[float, float] = {}
+    for samples in parts:
+        for got, value in samples.get(f"{name}_bucket", ()):
+            if "le" not in got or not _match(
+                    {k: v for k, v in got.items() if k != "le"}, labels):
+                continue
+            le = (float("inf") if got["le"] in ("+Inf", "inf")
+                  else float(got["le"]))
+            sums[le] = sums.get(le, 0.0) + value
+    if not sums:
+        return float("nan")
+    merged: Samples = {f"{name}_bucket": [
+        ({"le": "+Inf" if math.isinf(le) else repr(le)}, cum)
+        for le, cum in sums.items()]}
+    return histogram_percentile(merged, name, q)
+
+
+def replica_load(samples: Samples,
+                 default: float = float("inf")) -> float:
+    """Dispatch load score off the engine gauges PR 3 added: busy slots +
+    queued requests. Missing gauges (scrape raced server startup) score as
+    `default` so the router prefers replicas it can actually see."""
+    active = sample_value(samples, "engine_slots_active")
+    queued = sample_value(samples, "engine_queue_depth")
+    if math.isnan(active) and math.isnan(queued):
+        return default
+    return ((0.0 if math.isnan(active) else active)
+            + (0.0 if math.isnan(queued) else queued))

@@ -290,19 +290,27 @@ class PagedInferenceEngine(InferenceEngine):
                 continue
             with self._cv:
                 req = self._queue.popleft() if self._queue else None
+                if req is not None:
+                    # visible to wait_idle(): popped but not yet in a slot
+                    self._admitting += 1
             if req is None:
                 break
-            if not self._try_assign(i, req):
-                # pool can't cover the prompt right now: keep arrival
-                # order (front of the queue) and stop admitting — active
-                # slots retiring will free pages
+            try:
+                if not self._try_assign(i, req):
+                    # pool can't cover the prompt right now: keep arrival
+                    # order (front of the queue) and stop admitting —
+                    # active slots retiring will free pages
+                    with self._cv:
+                        self._queue.appendleft(req)
+                        self._m_queue.set(len(self._queue))
+                    break
+                n += 1
                 with self._cv:
-                    self._queue.appendleft(req)
                     self._m_queue.set(len(self._queue))
-                break
-            n += 1
-            with self._cv:
-                self._m_queue.set(len(self._queue))
+            finally:
+                with self._cv:
+                    self._admitting -= 1
+                self.last_progress_time = time.monotonic()
         return n
 
     def _try_assign(self, i: int, req: Request) -> bool:
@@ -538,8 +546,14 @@ class PagedInferenceEngine(InferenceEngine):
         """One engine tick: admit, run one prefill chunk, then one
         batched decode for every slot whose prompt is fully cached.
         Returns slots served + chunks run (0 = idle)."""
+        self._pre_tick()  # faults, staged weight swaps, deadline expiry
         self._admit()
         chunked = self._prefill_tick()
+        if chunked:
+            # chunked prefill with no decodable slots is still progress —
+            # without this a long multi-chunk prompt would trip the
+            # stalled() readiness check while prefilling normally
+            self.last_progress_time = time.monotonic()
         self._ensure_decode_pages()
         return self._decode_tick() + chunked
 

@@ -3,8 +3,29 @@
 Observability: GET /metrics returns the process metrics registry in
 Prometheus text format (slot occupancy, queue depth, TTFT and per-token
 latency histograms, admitted/retired counters, HTTP request counters —
-docs/observability.md) and GET /healthz a liveness probe, alongside the
-generation API below.
+docs/observability.md), GET /healthz a liveness probe ("the process and
+its step loop exist") and GET /readyz a readiness probe ("routing a
+request here right now would not queue-stall": 503 until the decode step
+is warmed, while draining or mid-reload, and when the step loop has
+pending work but stopped making progress). The fleet router
+(inference/fleet/router.py) and any k8s-style prober key off /readyz;
+/healthz deliberately stays green through drains so an orchestrator does
+not kill a replica that is merely finishing its in-flight work.
+
+Fleet control plane (POST, docs/serving.md "Fleet"):
+
+  /admin/drain    {"timeout_s": F}  stop admitting (new /api requests get
+                                    503 + Retry-After), wait for in-flight
+                                    requests to finish
+  /admin/readmit  {}                resume admission after a drain
+  /admin/reload   {"load": DIR, "iteration": N?}
+                                    hot weight reload: manifest-verified
+                                    committed checkpoint -> engine
+                                    update_params between decode ticks
+                                    (zero recompiles, zero dropped
+                                    requests)
+  /admin/status                     (GET) draining/ready/weights_version/
+                                    engine stats
 
 Equivalent of megatron/text_generation_server.py (241 LoC,
 Flask + flask_restful) on the stdlib http.server — PUT/POST /api with the
@@ -32,6 +53,9 @@ from __future__ import annotations
 
 import json
 import contextlib
+import os
+import signal
+import sys
 import threading
 import time
 
@@ -43,7 +67,9 @@ from megatron_tpu.config import ModelConfig
 from megatron_tpu.inference.api import (
     beam_search_and_post_process, generate_and_post_process,
 )
-from megatron_tpu.inference.engine import EngineOverloadedError
+from megatron_tpu.inference.engine import (
+    EngineOverloadedError, RequestTimeoutError,
+)
 from megatron_tpu.telemetry.http import PROMETHEUS_CONTENT_TYPE
 from megatron_tpu.telemetry.metrics import MetricsRegistry, default_registry
 
@@ -52,6 +78,14 @@ MAX_PROMPTS = 128
 #: Retry-After hint on 503 queue-full rejections: one decode tick's
 #: worth of backoff is enough for a slot to free in steady traffic
 RETRY_AFTER_SECONDS = 1
+#: engine progress-stall window before readiness flips (a hung device
+#: step keeps the thread "alive" — only lack of progress reveals it)
+STALL_THRESHOLD_SECONDS = 10.0
+
+
+class ServiceDrainingError(RuntimeError):
+    """The server is draining (SIGTERM grace or a rolling update): new
+    requests answer 503 + Retry-After so the router re-routes them."""
 
 
 class GenerationService:
@@ -62,7 +96,12 @@ class GenerationService:
                  engine_max_queue: Optional[int] = None,
                  kv_paging: bool = False, page_size: int = 16,
                  prefill_chunk: int = 32,
-                 num_pages: Optional[int] = None):
+                 num_pages: Optional[int] = None,
+                 request_timeout: Optional[float] = None,
+                 reload_dir: Optional[str] = None,
+                 weights_version: Optional[int] = None,
+                 stall_threshold_s: float = STALL_THRESHOLD_SECONDS,
+                 warmup: bool = False):
         """mesh + forward_fn serve sharded models: the mesh becomes
         ambient around generation (GSPMD handles tp/cp), forward_fn is the
         pp>1 pipelined forward (ref ForwardStep, forward_step.py:45-204).
@@ -73,7 +112,17 @@ class GenerationService:
         kv_paging swaps in the PagedInferenceEngine (shared page pool +
         radix prefix cache + chunked prefill, docs/serving.md);
         engine_max_queue bounds admission — overload answers 503 with
-        Retry-After instead of growing queue latency without bound."""
+        Retry-After instead of growing queue latency without bound.
+
+        request_timeout: default per-request deadline (seconds) on the
+        engine path — a queued or mid-decode request past it fails with
+        HTTP 504 instead of waiting forever (--serve_request_timeout).
+        reload_dir: default checkpoint dir for POST /admin/reload;
+        weights_version: iteration initially served (when loaded from a
+        committed checkpoint), reported in responses + /admin/status.
+        warmup=True defers readiness (/readyz stays 503) until warmup()
+        has compiled the decode step — run_server drives it on a
+        background thread so probes get answered during the compile."""
         if kv_cache_int8 and forward_fn is not None:
             # fail at construction, not as a 500 on every request — the
             # pipelined forward threads bf16 cache pairs (the same guard
@@ -91,6 +140,20 @@ class GenerationService:
         self.mesh = mesh
         self.forward_fn = forward_fn
         self.kv_cache_int8 = kv_cache_int8
+        self.request_timeout = request_timeout
+        self.reload_dir = reload_dir
+        self.weights_version = weights_version
+        self.stall_threshold_s = stall_threshold_s
+        self.draining = False
+        self.reloading = False
+        # readiness gate: set once the decode step is compiled (warmup()
+        # ran, or no warmup was requested and first-request compile is
+        # acceptable) — /readyz answers 503 until then so the router never
+        # routes a request into a multi-second compile stall
+        self._warmed = threading.Event()
+        # one admin mutation (drain/readmit/reload) at a time — a rolling
+        # update racing a second orchestrator must serialize, not interleave
+        self._admin_lock = threading.Lock()
         self.lock = threading.Lock()
         # one registry serves /metrics: the engine's slot/latency
         # collectors and the HTTP layer's request counters both land here
@@ -123,17 +186,151 @@ class GenerationService:
                     vocab_size=tokenizer.vocab_size, mesh=mesh,
                     metrics=self.metrics, max_queue=engine_max_queue)
             self.engine.start()
+        if not (warmup and self.engine is not None):
+            # no deferred warmup: the first request pays the compile (the
+            # pre-fleet behavior) and readiness is green from the start
+            self._warmed.set()
 
     def shutdown(self) -> None:
         """Stop the engine's step-loop thread (no-op without an engine)."""
         if self.engine is not None:
             self.engine.stop()
 
+    # ----- fleet control plane (docs/serving.md "Fleet") -------------------
+
+    def _journal(self, kind: str, **fields) -> None:
+        from megatron_tpu.telemetry.journal import get_global_journal
+
+        j = get_global_journal()
+        if j is not None:
+            j.emit(kind, **fields)
+
+    def warmup(self) -> None:
+        """Compile the engine's decode step + smallest prefill bucket with
+        a throwaway request, then flip readiness green. Runs on a
+        background thread (run_server) so /readyz answers 503 — not a
+        connection timeout — during the multi-second compile."""
+        if self.engine is not None and not self._warmed.is_set():
+            import numpy as np
+
+            t0 = time.monotonic()
+            self.engine.generate(np.array([[1]], np.int32),
+                                 np.array([1], np.int32), max_new_tokens=2)
+            self._journal("serve_warmup",
+                          wall_s=round(time.monotonic() - t0, 3))
+        self._warmed.set()
+
+    def ready(self) -> tuple:
+        """(ok, detail) for /readyz: would routing a request here right
+        now queue-stall? 503 while unwarmed, draining, mid-reload, or when
+        the step loop has pending work but stopped making progress."""
+        detail: dict = {"warmed": self._warmed.is_set(),
+                        "draining": self.draining,
+                        "reloading": self.reloading}
+        ok = detail["warmed"] and not self.draining and not self.reloading
+        if self.engine is not None:
+            alive = (self.engine._thread is None
+                     or self.engine._thread.is_alive())
+            stalled = self.engine.stalled(self.stall_threshold_s)
+            detail["step_loop_alive"] = alive
+            detail["stalled"] = stalled
+            ok = ok and alive and not stalled
+        if self.weights_version is not None:
+            detail["weights_version"] = self.weights_version
+        detail["ok"] = ok
+        return ok, detail
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Stop admitting (new /api requests answer 503 + Retry-After) and
+        wait for in-flight work to finish; True when fully drained within
+        `timeout_s`. The server keeps serving probes and admin requests —
+        readmit() undoes the drain."""
+        with self._admin_lock:
+            self.draining = True
+            self._journal("serve_drain_begin", timeout_s=timeout_s)
+            deadline = time.monotonic() + timeout_s
+            drained = (self.engine.wait_idle(timeout=timeout_s)
+                       if self.engine is not None else True)
+            if drained:
+                # even with an engine, beam-search and scoring requests
+                # run one-shot under self.lock — a drain that ignored
+                # them would report "drained" with a beam request still
+                # mid-generation and let a reload swap params under it
+                drained = self.lock.acquire(
+                    timeout=max(deadline - time.monotonic(), 0.001))
+                if drained:
+                    self.lock.release()
+            self._journal("serve_drain_done", drained=drained)
+            return drained
+
+    def readmit(self) -> None:
+        """Resume admission after a drain (rolling-update readmit step)."""
+        with self._admin_lock:
+            self.draining = False
+            self._journal("serve_readmit")
+
+    def reload(self, load: Optional[str] = None,
+               iteration: Optional[int] = None,
+               apply_timeout_s: float = 60.0) -> int:
+        """Hot weight reload: manifest-verify a committed checkpoint
+        (fleet/reload.py — torn or bitrotted saves never reach a serving
+        replica), stage it via engine.update_params, and wait for the
+        between-tick swap. In-flight slots keep decoding; the jit cache
+        key is unchanged so the swap costs zero recompiles (the live
+        decode_recompiles counter is the regression gate). Returns the
+        iteration now being served."""
+        from megatron_tpu.inference.fleet.reload import load_verified_params
+
+        if self.mesh is not None:
+            raise ValueError(
+                "hot reload on sharded (mesh) serving is not supported in "
+                "v1 — the reload path would re-place params without their "
+                "shardings; roll the replica instead (restart with the "
+                "new checkpoint)")
+        with self._admin_lock:
+            src = load or self.reload_dir
+            if not src:
+                raise ValueError(
+                    "no checkpoint dir to reload from: pass \"load\" in "
+                    "the request or start the server with reload_dir=")
+            self.reloading = True
+            try:
+                t0 = time.monotonic()
+                params, it = load_verified_params(src, self.params,
+                                                  iteration=iteration)
+                if self.engine is not None:
+                    applied = self.engine.update_params(params, version=it)
+                    if not applied.wait(timeout=apply_timeout_s):
+                        raise RuntimeError(
+                            f"weight swap staged but not applied within "
+                            f"{apply_timeout_s}s — is the step loop "
+                            "wedged? (/readyz would say)")
+                self.params = params
+                self.weights_version = it
+                self._journal("serve_weight_reload", version=it, load=src,
+                              wall_s=round(time.monotonic() - t0, 3))
+                return it
+            finally:
+                self.reloading = False
+
+    def admin_status(self) -> dict:
+        ok, detail = self.ready()
+        out = {"ready": ok, "detail": detail, "draining": self.draining,
+               "reloading": self.reloading,
+               "weights_version": self.weights_version}
+        if self.engine is not None:
+            out["engine"] = dict(self.engine.stats)
+        return out
+
     def _mesh_scope(self):
         return (jax.sharding.set_mesh(self.mesh) if self.mesh is not None
                 else contextlib.nullcontext())
 
     def handle(self, req: dict) -> dict:
+        if self.draining:
+            raise ServiceDrainingError(
+                "server is draining; retry (the fleet router re-routes "
+                "automatically)")
         prompts = req.get("prompts")
         if not isinstance(prompts, list) or not prompts:
             raise ValueError("prompts: non-empty list of strings required")
@@ -167,8 +364,23 @@ class GenerationService:
         # the one-shot path serializes whole requests and makes the mesh
         # ambient here (the engine's driver thread scopes its own)
         use_engine = self.engine is not None and n > 0
+        # per-request deadline (engine path): a request may SHORTEN the
+        # server default (--serve_request_timeout) but never extend past
+        # it — the operator bound caps the router's retry worst case and
+        # stops abandoned waiters from blocking slots, so a client
+        # (including one sending an explicit null) cannot opt out of it
+        deadline_s = req.get("deadline_s")
+        if deadline_s is not None:
+            try:
+                deadline_s = float(deadline_s)
+            except (TypeError, ValueError):
+                raise ValueError("deadline_s must be a number (seconds)")
+        if self.request_timeout is not None:
+            deadline_s = (self.request_timeout if deadline_s is None
+                          else min(deadline_s, self.request_timeout))
 
         def generate():
+            v0 = self.weights_version
             texts, segments, logprobs, _ = generate_and_post_process(
                 self.cfg, self.params, self.tokenizer, prompts,
                 tokens_to_generate=n,
@@ -180,10 +392,17 @@ class GenerationService:
                 random_seed=int(req.get("random_seed", 0)),
                 forward_fn=self.forward_fn,
                 kv_cache_int8=self.kv_cache_int8,
-                engine=self.engine if use_engine else None)
+                engine=self.engine if use_engine else None,
+                deadline_s=deadline_s if use_engine else None)
             out = {"text": texts, "segments": segments}
             if logprobs is not None:
                 out["logprobs"] = [list(map(float, row)) for row in logprobs]
+            # which weight version served this request: only claimed when
+            # it cannot lie — the version was the same before submit and
+            # after completion (a drained rolling update guarantees it;
+            # an undrained swap racing completion reports nothing)
+            if v0 is not None and v0 == self.weights_version:
+                out["weights_version"] = v0
             return out
 
         if use_engine:
@@ -204,15 +423,31 @@ def make_handler(service: GenerationService):
             self.end_headers()
             self.wfile.write(body)
 
+        def _read_json(self) -> dict:
+            length = int(self.headers.get("Content-Length", 0))
+            return json.loads(self.rfile.read(length) or b"{}")
+
         def _handle(self):
+            path = self.path.split("?", 1)[0]
+            if path.startswith("/admin/"):
+                self._handle_admin(path)
+                return
+            # anything else is the generation API (/api canonically; the
+            # pre-fleet server accepted any path, kept for compatibility)
             t0 = time.monotonic()
             status = "500"
             try:
-                length = int(self.headers.get("Content-Length", 0))
-                req = json.loads(self.rfile.read(length) or b"{}")
+                req = self._read_json()
                 payload = service.handle(req)
                 status = "200"
                 self._reply(200, payload)
+            except ServiceDrainingError as e:
+                # SIGTERM grace or a rolling update: fast 503 the router
+                # re-routes; Retry-After hints standalone clients
+                status = "503"
+                self._reply(503, {"message": str(e), "draining": True},
+                            headers=(("Retry-After",
+                                      str(RETRY_AFTER_SECONDS)),))
             except EngineOverloadedError as e:
                 # bounded admission (--serve_max_queue): overload degrades
                 # to fast 503s clients can back off on, not queue latency
@@ -220,6 +455,12 @@ def make_handler(service: GenerationService):
                 self._reply(503, {"message": str(e)},
                             headers=(("Retry-After",
                                       str(RETRY_AFTER_SECONDS)),))
+            except RequestTimeoutError as e:
+                # expired deadline (deadline_s / --serve_request_timeout):
+                # the client's budget is spent — the router passes 504
+                # through rather than retrying on its behalf
+                status = "504"
+                self._reply(504, {"message": str(e), "timeout": True})
             except ValueError as e:
                 status = "400"
                 self._reply(400, {"message": str(e)})
@@ -229,11 +470,47 @@ def make_handler(service: GenerationService):
                 service._m_requests.inc(status=status)
                 service._m_latency.observe(time.monotonic() - t0)
 
+        def _handle_admin(self, path: str):
+            from megatron_tpu.inference.fleet.reload import (
+                NoValidCheckpointError,
+            )
+
+            try:
+                req = self._read_json()
+            except ValueError:
+                self._reply(400, {"message": "admin body must be JSON"})
+                return
+            try:
+                if path == "/admin/drain":
+                    drained = service.drain(
+                        float(req.get("timeout_s", 30.0)))
+                    self._reply(200, {"drained": drained, "draining": True})
+                elif path == "/admin/readmit":
+                    service.readmit()
+                    self._reply(200, {"draining": False})
+                elif path == "/admin/reload":
+                    version = service.reload(
+                        load=req.get("load"),
+                        iteration=req.get("iteration"))
+                    self._reply(200, {"version": version})
+                else:
+                    self._reply(404, {"message":
+                                      "POST /admin/{drain,readmit,reload}"})
+            except NoValidCheckpointError as e:
+                # no verifiable committed checkpoint: an operator/ckpt
+                # problem, not a server fault — 409 so the router's
+                # rolling update stops and readmits the old weights
+                self._reply(409, {"message": str(e)})
+            except ValueError as e:
+                self._reply(400, {"message": str(e)})
+            except Exception as e:  # noqa: BLE001 — server must not die
+                self._reply(500, {"message": f"admin failed: {e}"})
+
         do_PUT = _handle
         do_POST = _handle
 
         def do_GET(self):
-            # observability endpoints (Prometheus scrape + liveness); the
+            # observability endpoints (Prometheus scrape + probes); the
             # generation API stays PUT/POST /api
             path = self.path.split("?", 1)[0]
             if path == "/metrics":
@@ -244,15 +521,24 @@ def make_handler(service: GenerationService):
                 self.end_headers()
                 self.wfile.write(body)
             elif path == "/healthz":
+                # liveness: "the process + step loop exist" — stays green
+                # through drains/reloads so an orchestrator doesn't kill a
+                # replica that's merely finishing in-flight work
                 alive = (service.engine is None
                          or service.engine._thread is None
                          or service.engine._thread.is_alive())
                 self._reply(200 if alive else 500,
                             {"ok": bool(alive),
                              "engine": service.engine is not None})
+            elif path == "/readyz":
+                ok, detail = service.ready()
+                self._reply(200 if ok else 503, detail)
+            elif path == "/admin/status":
+                self._reply(200, service.admin_status())
             else:
-                self._reply(404, {"message": "GET serves /metrics and "
-                                             "/healthz; the API is "
+                self._reply(404, {"message": "GET serves /metrics, "
+                                             "/healthz, /readyz, "
+                                             "/admin/status; the API is "
                                              "PUT/POST /api"})
 
         def log_message(self, *a):  # quiet
@@ -268,7 +554,21 @@ def run_server(cfg: ModelConfig, params: Any, tokenizer,
                engine_max_queue: Optional[int] = None,
                kv_paging: bool = False, page_size: int = 16,
                prefill_chunk: int = 32,
-               num_pages: Optional[int] = None) -> None:
+               num_pages: Optional[int] = None,
+               request_timeout: Optional[float] = None,
+               drain_timeout: float = 30.0,
+               warmup: bool = False,
+               port_file: Optional[str] = None,
+               reload_dir: Optional[str] = None,
+               weights_version: Optional[int] = None,
+               stall_threshold_s: float = STALL_THRESHOLD_SECONDS) -> None:
+    """Serve until killed. SIGTERM/SIGINT triggers a graceful drain
+    (mirroring DistributedSignalHandler): stop admitting (503 +
+    Retry-After), finish in-flight requests up to `drain_timeout`, then
+    exit cleanly; a second signal force-exits 128+signum immediately.
+    port=0 binds an ephemeral port; `port_file` (fleet subprocess
+    choreography) publishes the bound port as {"port": N} once listening.
+    warmup=True compiles the decode step before /readyz goes green."""
     service = GenerationService(cfg, params, tokenizer, mesh=mesh,
                                 forward_fn=forward_fn,
                                 kv_cache_int8=kv_cache_int8,
@@ -277,13 +577,73 @@ def run_server(cfg: ModelConfig, params: Any, tokenizer,
                                 engine_max_queue=engine_max_queue,
                                 kv_paging=kv_paging, page_size=page_size,
                                 prefill_chunk=prefill_chunk,
-                                num_pages=num_pages)
+                                num_pages=num_pages,
+                                request_timeout=request_timeout,
+                                reload_dir=reload_dir,
+                                weights_version=weights_version,
+                                stall_threshold_s=stall_threshold_s,
+                                warmup=warmup)
     server = ThreadingHTTPServer((host, port), make_handler(service))
+    bound_port = server.server_address[1]
+    if port_file:
+        # atomic publish: the parent polls this file — it must never read
+        # a torn write
+        tmp = port_file + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"port": bound_port, "pid": os.getpid()}, f)
+        os.replace(tmp, port_file)
+
+    received: list = []
+
+    def _graceful(signum, frame):
+        if received:
+            # second signal: the drain is presumed wedged — die NOW,
+            # unmaskably (DistributedSignalHandler semantics)
+            sys.stderr.write(
+                f"received {signal.Signals(signum).name} after "
+                f"{signal.Signals(received[0]).name}; forcing exit "
+                "without waiting for drain\n")
+            sys.stderr.flush()
+            os._exit(128 + signum)
+        received.append(signum)
+
+        def _shutdown():
+            drained = service.drain(drain_timeout)
+            print(f"drain {'complete' if drained else 'TIMED OUT'}; "
+                  "shutting down", flush=True)
+            server.shutdown()
+
+        # drain off-signal-context: a handler must not block for seconds
+        threading.Thread(target=_shutdown, daemon=True,
+                         name="drain-on-signal").start()
+
+    if threading.current_thread() is threading.main_thread():
+        for s in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(s, _graceful)
+
+    if warmup and service.engine is not None:
+        # compile on a side thread so serve_forever answers probes (503,
+        # not connection timeouts) during the warmup
+
+        def _warmup():
+            try:
+                service.warmup()
+            except Exception as e:  # noqa: BLE001 - a failed warmup keeps
+                # readiness red (correct: don't route here) but the reason
+                # must reach the log, not die with the thread
+                sys.stderr.write(f"warmup failed: {e}\n")
+                sys.stderr.flush()
+
+        threading.Thread(target=_warmup, daemon=True,
+                         name="serve-warmup").start()
+
     mode = (f"continuous batching, {engine_slots} slots"
             + (", paged KV + prefix cache" if kv_paging else "")
             if service.engine else "one-shot")
-    print(f"serving generation API on http://{host}:{port}/api ({mode})")
+    print(f"serving generation API on http://{host}:{bound_port}/api "
+          f"({mode})", flush=True)
     try:
         server.serve_forever()
     finally:
+        server.server_close()
         service.shutdown()

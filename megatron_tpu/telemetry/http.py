@@ -1,10 +1,17 @@
-"""Sidecar HTTP listener exposing /metrics (Prometheus text) + /healthz.
+"""Sidecar HTTP listener: /metrics (Prometheus text), /healthz, /readyz.
 
 The serving server mounts /metrics on its own port (inference/server.py);
 this listener is for processes that are NOT otherwise HTTP servers — the
 train loop (`--metrics_port`) and batch tools — so Prometheus can scrape
 them too. Stdlib-only (ThreadingHTTPServer on a daemon thread), like the
 generation server.
+
+Liveness vs readiness (docs/observability.md): /healthz answers "is the
+process worth keeping alive" (500 = restart me), /readyz answers "should
+traffic route here right now" (503 = skip me, I'm warming up / draining /
+wedged). A process that serves no traffic can ignore `ready` — /readyz
+then mirrors /healthz — but anything behind the fleet router
+(inference/fleet/router.py) or a k8s-style prober should wire both.
 """
 
 from __future__ import annotations
@@ -20,8 +27,13 @@ PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
 def metrics_app(registry: MetricsRegistry,
-                health: Optional[Callable[[], dict]] = None):
-    """Handler class serving GET /metrics and /healthz off `registry`."""
+                health: Optional[Callable[[], dict]] = None,
+                ready: Optional[Callable[[], dict]] = None):
+    """Handler class serving GET /metrics, /healthz, /readyz off
+    `registry`. `health`/`ready` return dicts whose "ok" key decides the
+    status code (healthz: 500 when false; readyz: 503 — "not ready" is a
+    routing hint, not a process fault); a raising probe IS the negative
+    signal. ready=None mirrors liveness on /readyz."""
 
     class Handler(BaseHTTPRequestHandler):
         def _send(self, code: int, body: bytes, ctype: str):
@@ -31,23 +43,30 @@ def metrics_app(registry: MetricsRegistry,
             self.end_headers()
             self.wfile.write(body)
 
+        def _probe(self, fn: Optional[Callable[[], dict]],
+                   fail_code: int) -> None:
+            payload = {"ok": True}
+            if fn is not None:
+                try:
+                    payload.update(fn())
+                except Exception as e:  # noqa: BLE001 - health probe
+                    # failing IS the health signal
+                    payload = {"ok": False, "error": str(e)}
+            self._send(200 if payload.get("ok") else fail_code,
+                       json.dumps(payload).encode(), "application/json")
+
         def do_GET(self):
             path = self.path.split("?", 1)[0]
             if path == "/metrics":
                 self._send(200, registry.render().encode(),
                            PROMETHEUS_CONTENT_TYPE)
             elif path == "/healthz":
-                payload = {"ok": True}
-                if health is not None:
-                    try:
-                        payload.update(health())
-                    except Exception as e:  # noqa: BLE001 - health probe
-                        # failing IS the health signal
-                        payload = {"ok": False, "error": str(e)}
-                self._send(200 if payload.get("ok") else 500,
-                           json.dumps(payload).encode(), "application/json")
+                self._probe(health, 500)
+            elif path == "/readyz":
+                self._probe(ready if ready is not None else health, 503)
             else:
-                self._send(404, b'{"message": "try /metrics or /healthz"}',
+                self._send(404, b'{"message": "try /metrics, /healthz '
+                                b'or /readyz"}',
                            "application/json")
 
         def log_message(self, *a):  # quiet, like the generation server
@@ -61,9 +80,10 @@ class MetricsServer:
 
     def __init__(self, registry: MetricsRegistry, port: int,
                  host: str = "0.0.0.0",
-                 health: Optional[Callable[[], dict]] = None):
+                 health: Optional[Callable[[], dict]] = None,
+                 ready: Optional[Callable[[], dict]] = None):
         self._server = ThreadingHTTPServer(
-            (host, port), metrics_app(registry, health))
+            (host, port), metrics_app(registry, health, ready=ready))
         self.port = self._server.server_address[1]  # resolved when port=0
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True,
@@ -81,7 +101,9 @@ class MetricsServer:
 
 def start_metrics_server(registry: MetricsRegistry, port: int,
                          host: str = "0.0.0.0",
-                         health: Optional[Callable[[], dict]] = None
+                         health: Optional[Callable[[], dict]] = None,
+                         ready: Optional[Callable[[], dict]] = None
                          ) -> MetricsServer:
     """Bind + serve; port=0 picks a free port (read it off .port)."""
-    return MetricsServer(registry, port, host=host, health=health).start()
+    return MetricsServer(registry, port, host=host, health=health,
+                         ready=ready).start()

@@ -33,6 +33,25 @@ provides
                               finalization — widens the async-save commit
                               window for deterministic overlap tests
 
+    Serving faults (docs/fault_tolerance.md), threaded through the
+    inference engine's tick loop and admission path so every fleet
+    failover path (inference/fleet/router.py) is deterministically
+    testable on CPU:
+
+      kill_replica:N          SIGKILL the serving process right before
+                              decode tick N — a replica dying mid-stream
+                              (the router must fail affected clients over)
+      hang_replica:N          wedge the engine's step loop forever at
+                              decode tick N — a hung device step, the
+                              failure /healthz liveness can't see but
+                              request timeouts + the router's breaker can
+      slow_tick:MS            sleep MS milliseconds before every decode
+                              tick — degraded-replica latency, for
+                              deadline/SLO tests
+      reject_admission        while armed, every engine submit() is
+                              rejected as overloaded (HTTP 503) — drives
+                              the router's retry-on-overload path
+
 The env var is re-parsed when its value changes, so tests can monkeypatch
 it without reimporting.
 """
@@ -82,6 +101,13 @@ def fault_args(kind: str) -> Optional[Tuple[int, ...]]:
     return parse_fault_env().get(kind)
 
 
+def fault_armed(kind: str) -> bool:
+    """Whether `kind` appears in the fault env at all — for faults with no
+    iteration argument (reject_admission) that fire for as long as they
+    are armed."""
+    return fault_args(kind) is not None
+
+
 def fault_active(kind: str, iteration: int) -> bool:
     """Whether `kind` fires at `iteration`. kill_* faults fire at exactly
     their ITER; nan_loss fires over [ITER, ITER+N)."""
@@ -119,14 +145,44 @@ def maybe_kill(kind: str, iteration: int) -> None:
         os.kill(os.getpid(), signal.SIGKILL)
 
 
-def maybe_sleep(kind: str = "slow_save") -> None:
-    """Sleep args[0] milliseconds if the fault is armed (no iteration)."""
+#: sleep-fault kinds already journaled once this process (see
+#: maybe_sleep's journal_once)
+_journaled_sleeps: set = set()
+
+
+def maybe_sleep(kind: str = "slow_save", journal_once: bool = False) -> None:
+    """Sleep args[0] milliseconds if the fault is armed (no iteration).
+
+    journal_once=True journals only the FIRST firing per process — for
+    faults that fire on every decode tick (slow_tick), where a per-tick
+    line would drown the journal. Per-occurrence faults (slow_save: one
+    firing per checkpoint) keep the default and journal every firing, so
+    a two-save run still shows two fault_injection events."""
     args = fault_args(kind)
     if args:
         import time
 
-        _journal_fault(kind, ms=args[0])
+        if not (journal_once and kind in _journaled_sleeps):
+            _journaled_sleeps.add(kind)
+            _journal_fault(kind, ms=args[0])
         time.sleep(args[0] / 1000.0)
+
+
+def maybe_hang(kind: str, iteration: int) -> None:
+    """Wedge the calling thread forever if the fault is armed for
+    `iteration` — a hung device step or deadlocked driver: the process
+    stays alive (liveness probes still answer) but never makes progress,
+    which only request deadlines and the router's circuit breaker catch."""
+    if fault_active(kind, iteration):
+        import time
+
+        sys.stderr.write(
+            f"MEGATRON_TPU_FAULT: {kind} firing at iteration {iteration} — "
+            "hanging thread forever\n")
+        sys.stderr.flush()
+        _journal_fault(kind, iteration=iteration)
+        while True:
+            time.sleep(3600)
 
 
 def poison_batch(batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:

@@ -51,7 +51,7 @@ def test_bench_main_emits_one_json_line(monkeypatch):
     lines = [l for l in buf.getvalue().splitlines() if l.strip()]
     # full (non-quick) runs: the serving metric lines, then the headline
     # LAST (the only positional contract the driver relies on)
-    assert len(lines) == 3
+    assert len(lines) == 4
     serve = json.loads(lines[0])
     assert serve["metric"] == "serve_decode_throughput_toks_per_s"
     assert set(serve) >= {"metric", "value", "unit", "vs_baseline"}
@@ -64,6 +64,13 @@ def test_bench_main_emits_one_json_line(monkeypatch):
     # shared-system-prompt traffic via the radix prefix cache
     assert prefix["value"] >= 1.5, prefix
     assert prefix["detail"]["decode_recompiles_after_warmup"] == 0
+    slo = json.loads(lines[2])
+    assert slo["metric"] == "serve_slo_offered_load"
+    assert "error" not in slo, slo
+    # every request must complete (a lost request zeroes the line) and
+    # the percentile block must be populated
+    assert slo["value"] > 0 and slo["detail"]["failed"] == 0, slo
+    assert set(slo["detail"]["ttft_s"]) == {"p50", "p95", "p99"}
     out = json.loads(lines[-1])
     assert out["metric"] == "llama_train_step_mfu"
     assert set(out) >= {"metric", "value", "unit", "vs_baseline", "detail"}

@@ -1,0 +1,949 @@
+"""Serving fleet tests: router, hot reload, drain, deadlines, chaos.
+
+Fast tier-1 coverage:
+  * Prometheus text scraping round-trips the registry's own exposition
+    (the cross-process contract the router/SLO harness depend on);
+  * router dispatch units against stub HTTP replicas (no jax): least
+    loaded pick, failover on a dead replica, 503 routed around without
+    breaker penalty, 4xx passthrough, breaker open + probe readmit,
+    rolling-update admin choreography;
+  * deadline expiry (queued and mid-decode), injected admission
+    rejection, readiness/drain/hot-reload on one in-process engine-backed
+    server (one compile shared by the whole block);
+  * the SLO trace/report math on synthetic inputs, and the
+    telemetry-report serving section.
+
+Slow (real subprocess) coverage — the acceptance gates:
+  * SIGKILL one of 2 replicas mid-stream under concurrent traffic
+    (`kill_replica` fault): every request completes via failover,
+    token-identical to the survivor's solo answers; the router marks the
+    replica dead and readmits it after a respawn;
+  * rolling weight update under live traffic: zero dropped requests,
+    zero decode recompiles, responses token-identical to solo runs of
+    whichever weight version served them;
+  * graceful drain on SIGTERM; hung-replica readiness (`hang_replica`);
+    the paged-engine variant of router failover; the
+    serve_slo_offered_load bench line.
+"""
+
+import json
+import os
+import socket
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from megatron_tpu.inference.fleet import scrape, slo
+from megatron_tpu.inference.fleet.router import ReplicaRouter
+from megatron_tpu.telemetry.metrics import MetricsRegistry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# scrape: the cross-process metrics contract
+
+
+def test_scrape_roundtrips_registry_exposition():
+    reg = MetricsRegistry()
+    g = reg.gauge("engine_slots_active", "busy slots")
+    c = reg.counter("engine_requests_admitted_total", "admissions",
+                    label_names=("status",))
+    h = reg.histogram("engine_ttft_seconds", "ttft")
+    g.set(3)
+    c.inc(status="200")
+    c.inc(status="200")
+    for v in (0.002, 0.02, 0.02, 0.2, 2.0):
+        h.observe(v)
+    samples = scrape.parse_prom_text(reg.render())
+    assert scrape.sample_value(samples, "engine_slots_active") == 3
+    assert scrape.sample_value(samples, "engine_requests_admitted_total",
+                               status="200") == 2
+    # bucket-quantile semantics must agree with the in-process helper
+    for q in (0.5, 0.95, 0.99):
+        assert (scrape.histogram_percentile(samples, "engine_ttft_seconds",
+                                            q)
+                == h.percentile(q))
+    # label unescaping is single-pass: an escaped backslash before 'n'
+    # must not collapse into a newline
+    esc = scrape.parse_prom_text(r'm{p="C:\\new",q="a\nb"} 1')
+    labels = esc["m"][0][0]
+    assert labels == {"p": "C:\\new", "q": "a\nb"}
+
+
+def test_scrape_diff_and_merge():
+    reg = MetricsRegistry()
+    h = reg.histogram("engine_ttft_seconds", "ttft")
+    h.observe(5.0)  # "warmup" observation that a window diff must drop
+    before = scrape.parse_prom_text(reg.render())
+    for _ in range(10):
+        h.observe(0.01)
+    after = scrape.parse_prom_text(reg.render())
+    delta = scrape.diff_samples(before, after)
+    # the 5s warmup sample is outside the window: p99 reads the 10ms
+    # bucket, not the warmup's
+    assert scrape.histogram_percentile(delta, "engine_ttft_seconds",
+                                       0.99) == 0.01
+    # fleet-wide merge: two replicas' windows sum per bucket
+    merged = scrape.merged_histogram_percentile([delta, delta],
+                                                "engine_ttft_seconds", 0.5)
+    assert merged == 0.01
+    assert scrape.replica_load(
+        {"engine_slots_active": [({}, 2.0)],
+         "engine_queue_depth": [({}, 3.0)]}) == 5.0
+    assert scrape.replica_load({}) == float("inf")
+
+
+def test_slo_trace_deterministic_and_report_math():
+    t1 = slo.make_trace(32, 8.0, seed=3)
+    t2 = slo.make_trace(32, 8.0, seed=3)
+    assert t1 == t2
+    assert t1 != slo.make_trace(32, 8.0, seed=4)
+    gaps = [b["at_s"] - a["at_s"] for a, b in zip(t1, t1[1:])]
+    assert 0.02 < sum(gaps) / len(gaps) < 0.5  # ~1/8 s mean inter-arrival
+
+    results = [{"at_s": 0.1 * i, "wall_s": 0.2, "status": 200, "ok": True}
+               for i in range(10)]
+    results.append({"at_s": 1.1, "wall_s": 0.1, "status": 502, "ok": False})
+    reg = MetricsRegistry()
+    h = reg.histogram("engine_ttft_seconds", "ttft")
+    before = scrape.parse_prom_text(reg.render())
+    for _ in range(10):
+        h.observe(0.05)
+    after = scrape.parse_prom_text(reg.render())
+    report = slo.slo_report(results, [before], [after], offered_rps=8.0)
+    assert report["completed"] == 10 and report["failed"] == 1
+    assert report["status_counts"]["502"] == 1
+    assert report["ttft_s"]["p50"] == 0.05
+    assert report["client_wall_s"]["p50"] == 0.2
+
+
+def test_telemetry_report_serving_section():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import telemetry_report
+    finally:
+        sys.path.pop(0)
+    events = (
+        [{"kind": "serve_request", "status": "ok", "ttft_s": 0.05,
+          "tpot_s": 0.01, "wall_s": 0.3}] * 9
+        + [{"kind": "serve_request", "status": "timeout", "wall_s": 1.0}]
+        + [{"kind": "serve_route", "status": 200, "attempts": 1}] * 8
+        + [{"kind": "serve_route", "status": 200, "attempts": 2}]
+        + [{"kind": "serve_route", "status": 503, "attempts": 3,
+            "exhausted": True}]
+        + [{"kind": "replica_breaker_open", "replica": "u"},
+           {"kind": "replica_readmitted", "replica": "u"},
+           {"kind": "serve_drain_begin", "timeout_s": 5},
+           {"kind": "weight_reload", "version": 2}])
+    summary = telemetry_report.summarize(events)
+    sv = summary["serving"]
+    assert sv["requests"]["total"] == 10
+    assert sv["requests"]["by_status"] == {"ok": 9, "timeout": 1}
+    assert sv["ttft_s"]["p50"] == 0.05
+    assert sv["router"] == {"routed": 10, "retries": 3, "failovers": 1,
+                            "exhausted": 1}
+    assert sv["fleet"] == {"breaker_opens": 1, "readmits": 1, "drains": 1,
+                           "weight_reloads": 1}
+    text = telemetry_report.render(summary)
+    assert "failovers" in text and "tpot" in text
+
+
+# ---------------------------------------------------------------------------
+# router units against stub replicas (pure host — no jax, no engine)
+
+
+class StubReplica:
+    """Configurable fake replica: /readyz, /metrics gauges, /api, /admin."""
+
+    def __init__(self, ready=True, load=0.0, api_status=200,
+                 api_delay=0.0):
+        self.ready = ready
+        self.load = load
+        self.api_status = api_status
+        self.api_delay = api_delay
+        self.api_calls = 0
+        self.admin_calls = []
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _reply(self, code, payload, ctype="application/json"):
+                body = (payload if isinstance(payload, bytes)
+                        else json.dumps(payload).encode())
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path == "/readyz":
+                    self._reply(200 if stub.ready else 503,
+                                {"ok": stub.ready})
+                elif path == "/metrics":
+                    self._reply(200,
+                                (f"engine_slots_active {stub.load}\n"
+                                 "engine_queue_depth 0\n").encode(),
+                                ctype="text/plain")
+                else:
+                    self._reply(404, {})
+
+            def do_POST(self):
+                path = self.path.split("?", 1)[0]
+                length = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(length)
+                if path == "/api":
+                    stub.api_calls += 1
+                    if stub.api_delay:
+                        time.sleep(stub.api_delay)
+                    self._reply(stub.api_status,
+                                {"text": [f"stub:{stub.port}"]})
+                elif path.startswith("/admin/"):
+                    stub.admin_calls.append(path)
+                    if path == "/admin/drain":
+                        self._reply(200, {"drained": True})
+                    elif path == "/admin/reload":
+                        self._reply(200, {"version": 42})
+                    else:
+                        self._reply(200, {})
+                else:
+                    self._reply(404, {})
+
+            def log_message(self, *a):
+                pass
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def _dead_url():
+    """A URL nothing listens on (bind an ephemeral port, then free it)."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return f"http://127.0.0.1:{port}"
+
+
+BODY = json.dumps({"prompts": ["1 2"], "tokens_to_generate": 2}).encode()
+
+
+def _router_counter(router, name, **labels):
+    samples = scrape.parse_prom_text(router.metrics.render())
+    return scrape.sample_value(samples, name, default=0.0, **labels)
+
+
+def test_router_picks_least_loaded():
+    busy, idle = StubReplica(load=5.0), StubReplica(load=0.0)
+    try:
+        router = ReplicaRouter([busy.url, idle.url],
+                               metrics=MetricsRegistry())
+        router.probe_once()  # reads the stub gauges
+        status, _, body = router.dispatch(BODY)
+        assert status == 200
+        assert idle.api_calls == 1 and busy.api_calls == 0
+        assert f"stub:{idle.port}" in body.decode()
+    finally:
+        busy.close()
+        idle.close()
+
+
+def test_router_failover_on_dead_replica():
+    live = StubReplica()
+    try:
+        # dead listed first: equal load scores tie-break to list order,
+        # so the first attempt hits the dead one and must fail over
+        router = ReplicaRouter([_dead_url(), live.url], retry_backoff_s=0.0,
+                               metrics=MetricsRegistry())
+        status, _, _ = router.dispatch(BODY)
+        assert status == 200
+        assert live.api_calls == 1
+        assert _router_counter(router, "router_failovers_total") == 1
+        assert _router_counter(router, "router_retries_total") == 1
+    finally:
+        live.close()
+
+
+def test_router_routes_around_503_without_breaker_penalty():
+    full = StubReplica(api_status=503)
+    live = StubReplica(load=1.0)  # higher load: 503 stub is tried first
+    try:
+        router = ReplicaRouter([full.url, live.url], retry_backoff_s=0.0,
+                               metrics=MetricsRegistry())
+        router.probe_once()
+        status, _, _ = router.dispatch(BODY)
+        assert status == 200
+        assert full.api_calls == 1 and live.api_calls == 1
+        # overloaded != broken: no failure recorded, breaker stays closed
+        assert router.replicas[0].failures == 0
+        assert _router_counter(router, "router_breaker_opens_total") == 0
+    finally:
+        full.close()
+        live.close()
+
+
+def test_router_passes_4xx_through_without_retry():
+    bad = StubReplica(api_status=400)
+    other = StubReplica(load=9.0)
+    try:
+        router = ReplicaRouter([bad.url, other.url], retry_backoff_s=0.0,
+                               metrics=MetricsRegistry())
+        router.probe_once()
+        status, _, _ = router.dispatch(BODY)
+        # a malformed request fails identically everywhere: retrying would
+        # only multiply the error rate
+        assert status == 400
+        assert bad.api_calls == 1 and other.api_calls == 0
+    finally:
+        bad.close()
+        other.close()
+
+
+def test_router_passes_504_through_without_retry_or_penalty():
+    slow = StubReplica(api_status=504)
+    other = StubReplica(load=9.0)
+    try:
+        router = ReplicaRouter([slow.url, other.url], retry_backoff_s=0.0,
+                               metrics=MetricsRegistry())
+        router.probe_once()
+        status, _, _ = router.dispatch(BODY)
+        # an expired deadline means the client's budget is spent: no
+        # retry (it would double the wasted compute), no breaker penalty
+        # (the replica is healthy)
+        assert status == 504
+        assert slow.api_calls == 1 and other.api_calls == 0
+        assert router.replicas[0].failures == 0
+    finally:
+        slow.close()
+        other.close()
+
+
+def test_rolling_update_survives_unreachable_replica():
+    live = StubReplica()
+    try:
+        router = ReplicaRouter([_dead_url(), live.url], retry_backoff_s=0.0,
+                               metrics=MetricsRegistry())
+        results = router.rolling_update(load="ckpts", drain_timeout=1.0)
+        # stops at the first failing replica; cleanup still ran, so the
+        # dead replica is NOT stuck excluded from dispatch forever
+        assert len(results) == 1 and "error" in results[0]
+        assert not router.replicas[0].updating
+        assert not router.replicas[1].updating
+        assert live.admin_calls == []  # rollout never reached it
+        assert router.dispatch(BODY)[0] == 200  # the fleet keeps serving
+    finally:
+        live.close()
+
+
+def test_router_breaker_opens_then_probe_readmits():
+    stub = StubReplica(api_status=500)
+    try:
+        router = ReplicaRouter([stub.url], retry_backoff_s=0.0,
+                               breaker_failures=3, breaker_base_s=60.0,
+                               readmit_streak=2, metrics=MetricsRegistry())
+        assert router.dispatch(BODY)[0] == 500
+        assert router.dispatch(BODY)[0] in (500, 503)
+        rep = router.replicas[0]
+        assert rep.breaker_open(time.monotonic())
+        assert _router_counter(router, "router_breaker_opens_total") == 1
+        assert router._num_routable() == 0
+        # breaker open: dispatch answers 503 without touching the replica
+        calls = stub.api_calls
+        status, headers, _ = router.dispatch(BODY)
+        assert status == 503 and "Retry-After" in headers
+        assert stub.api_calls == calls
+        # the replica recovers; consecutive readiness probes readmit it
+        # without burning a client request as the half-open trial
+        stub.api_status = 200
+        router.probe_once()
+        assert router._num_routable() == 0  # streak 1 of 2
+        router.probe_once()
+        assert router._num_routable() == 1
+        assert not rep.breaker_open(time.monotonic())
+        assert router.dispatch(BODY)[0] == 200
+    finally:
+        stub.close()
+
+
+def test_router_all_dead_answers_503_with_retry_after():
+    router = ReplicaRouter([_dead_url()], retry_backoff_s=0.0,
+                           metrics=MetricsRegistry())
+    status, headers, body = router.dispatch(BODY)
+    # bounded: attempts exhausted, last transport failure reported
+    assert status == 502
+    router.replicas[0].breaker_open_until = time.monotonic() + 60
+    status, headers, _ = router.dispatch(BODY)
+    assert status == 503 and "Retry-After" in headers
+
+
+def test_rolling_update_admin_choreography():
+    a, b = StubReplica(), StubReplica()
+    try:
+        router = ReplicaRouter([a.url, b.url], metrics=MetricsRegistry())
+        results = router.rolling_update(load="ckpts", iteration=2,
+                                        drain_timeout=5.0)
+        assert len(results) == 2
+        for stub, res in zip((a, b), results):
+            assert "error" not in res
+            assert res["version"] == 42
+            assert res["ready"] is True
+            # one replica at a time, in order: drain -> reload -> readmit
+            assert stub.admin_calls == ["/admin/drain", "/admin/reload",
+                                        "/admin/readmit"]
+            assert not router.replicas[results.index(res)].updating
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# engine-backed server: readiness, drain, deadlines, hot reload (one
+# in-process service — a single decode compile covers the whole block)
+
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from megatron_tpu.inference.engine import InferenceEngine, Request  # noqa: E402
+from megatron_tpu.inference.fleet.reload import (  # noqa: E402
+    save_params_checkpoint,
+)
+from megatron_tpu.inference.server import (  # noqa: E402
+    GenerationService, make_handler,
+)
+from megatron_tpu.models import presets  # noqa: E402
+from megatron_tpu.models.params import init_params  # noqa: E402
+from megatron_tpu.tokenizer.tokenizer import NullTokenizer  # noqa: E402
+
+CFG = presets.tiny(vocab_size=64, seq_length=64)
+PARAMS = init_params(CFG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def fleet_service():
+    svc = GenerationService(CFG, PARAMS, NullTokenizer(CFG.vocab_size - 1),
+                            engine_slots=2, engine_max_seq_len=64,
+                            metrics=MetricsRegistry(), warmup=True)
+    server = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(svc))
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        yield svc, f"http://127.0.0.1:{server.server_address[1]}"
+    finally:
+        server.shutdown()
+        server.server_close()
+        svc.shutdown()
+
+
+def _get(url, path):
+    try:
+        with urllib.request.urlopen(url + path, timeout=60) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _post(url, path, payload, timeout=120):
+    req = urllib.request.Request(url + path,
+                                 data=json.dumps(payload).encode(),
+                                 method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_readiness_gates_on_warmup(fleet_service):
+    svc, url = fleet_service
+    if not svc._warmed.is_set():  # first test in the block sees unwarmed
+        code, body = _get(url, "/readyz")
+        assert code == 503 and body["warmed"] is False
+        # liveness stays green while unwarmed — restart would not help
+        assert _get(url, "/healthz")[0] == 200
+    svc.warmup()
+    code, body = _get(url, "/readyz")
+    assert code == 200 and body["ok"] is True
+
+
+def test_drain_and_readmit_over_http(fleet_service):
+    svc, url = fleet_service
+    svc.warmup()
+    code, body = _post(url, "/admin/drain", {"timeout_s": 10})
+    assert code == 200 and body["drained"] is True
+    code, body = _post(url, "/api", {"prompts": ["3 4"],
+                                     "tokens_to_generate": 2})
+    assert code == 503 and body.get("draining")
+    assert _get(url, "/readyz")[0] == 503
+    assert _get(url, "/healthz")[0] == 200  # liveness green through drain
+    assert _post(url, "/admin/readmit", {})[0] == 200
+    assert _get(url, "/readyz")[0] == 200
+    assert _post(url, "/api", {"prompts": ["3 4"],
+                               "tokens_to_generate": 2})[0] == 200
+
+
+def test_injected_admission_rejection_maps_503(fleet_service, monkeypatch):
+    svc, url = fleet_service
+    svc.warmup()
+    monkeypatch.setenv("MEGATRON_TPU_FAULT", "reject_admission")
+    code, body = _post(url, "/api", {"prompts": ["5"],
+                                     "tokens_to_generate": 2})
+    assert code == 503 and "reject_admission" in body["message"]
+    monkeypatch.setenv("MEGATRON_TPU_FAULT", "")
+    assert _post(url, "/api", {"prompts": ["5"],
+                               "tokens_to_generate": 2})[0] == 200
+
+
+def test_deadline_expires_queued_request(fleet_service, monkeypatch):
+    svc, url = fleet_service
+    svc.warmup()
+    eng = svc.engine
+    timeouts0 = eng.stats["timeouts"]
+    monkeypatch.setenv("MEGATRON_TPU_FAULT", "slow_tick:50")
+    # fill both slots with slow long requests, then queue one with a
+    # deadline shorter than the slot wait: it must fail while QUEUED
+    long = [eng.submit(Request(prompt=np.array([7, 8], np.int32),
+                               max_new_tokens=30))
+            for _ in range(2)]
+    victim = eng.submit(Request(prompt=np.array([9], np.int32),
+                                max_new_tokens=4, deadline_s=0.3))
+    assert victim.done.wait(timeout=10)
+    assert victim.timed_out and "queued" in victim.error
+    assert eng.stats["timeouts"] == timeouts0 + 1
+    monkeypatch.setenv("MEGATRON_TPU_FAULT", "")
+    for r in long:
+        assert r.done.wait(timeout=30) and r.error is None
+
+
+def test_deadline_expires_mid_decode(fleet_service, monkeypatch):
+    svc, url = fleet_service
+    svc.warmup()
+    monkeypatch.setenv("MEGATRON_TPU_FAULT", "slow_tick:50")
+    code, body = _post(url, "/api", {"prompts": ["3 4"],
+                                     "tokens_to_generate": 60,
+                                     "deadline_s": 0.4})
+    assert code == 504 and "mid-decode" in body["message"]
+    monkeypatch.setenv("MEGATRON_TPU_FAULT", "")
+    # the slot was reclaimed; the engine keeps serving
+    assert _post(url, "/api", {"prompts": ["3 4"],
+                               "tokens_to_generate": 2})[0] == 200
+
+
+def test_deadline_client_cannot_extend_server_bound(fleet_service,
+                                                    monkeypatch):
+    svc, url = fleet_service
+    svc.warmup()
+    monkeypatch.setattr(svc, "request_timeout", 0.3)
+    monkeypatch.setenv("MEGATRON_TPU_FAULT", "slow_tick:50")
+    # explicit null and an absurd client deadline both stay bounded by
+    # the operator cap — a client cannot opt out of the protection
+    for client_deadline in (None, 1e9):
+        code, body = _post(url, "/api",
+                           {"prompts": ["3 4"], "tokens_to_generate": 60,
+                            "deadline_s": client_deadline})
+        assert code == 504, (client_deadline, code, body)
+    monkeypatch.setenv("MEGATRON_TPU_FAULT", "")
+    # a non-numeric deadline is a client error, not a 500
+    code, body = _post(url, "/api", {"prompts": ["3"],
+                                     "tokens_to_generate": 2,
+                                     "deadline_s": []})
+    assert code == 400 and "deadline_s" in body["message"]
+
+
+def test_deadline_must_be_positive():
+    eng = InferenceEngine(CFG, PARAMS, num_slots=1, max_seq_len=64)
+    req = eng.submit(Request(prompt=np.array([3], np.int32),
+                             max_new_tokens=2, deadline_s=0.0))
+    assert req.done.is_set() and "deadline_s" in req.error
+
+
+def test_stalled_requires_pending_work():
+    eng = InferenceEngine(CFG, PARAMS, num_slots=1, max_seq_len=64)
+    # idle forever is healthy, not stalled
+    eng.last_progress_time -= 1000
+    assert not eng.stalled(1.0)
+    # pending work + no progress = stalled (the hung-step-loop signal
+    # /readyz uses; the step loop was never started here)
+    eng.submit(Request(prompt=np.array([3], np.int32), max_new_tokens=2))
+    assert eng.stalled(1.0)
+    assert not eng.stalled(1e6)
+
+
+def test_hot_reload_over_http(fleet_service, tmp_path):
+    svc, url = fleet_service
+    svc.warmup()
+    eng = svc.engine
+    prompt = {"prompts": ["9 10 11 12"], "tokens_to_generate": 8}
+    before = _post(url, "/api", prompt)[1]
+    reloads0 = eng.stats["weight_reloads"]
+    recompiles0 = eng.stats["decode_recompiles"]
+    # a checkpoint with genuinely different weights
+    save_params_checkpoint(str(tmp_path), 3,
+                           init_params(CFG, jax.random.PRNGKey(7)))
+    code, body = _post(url, "/admin/reload", {"load": str(tmp_path)})
+    assert code == 200 and body["version"] == 3
+    code, status = _get(url, "/admin/status")
+    assert status["weights_version"] == 3
+    after = _post(url, "/api", prompt)[1]
+    assert after.get("weights_version") == 3
+    assert after["text"] != before["text"]  # the new weights answered
+    assert eng.stats["weight_reloads"] == reloads0 + 1
+    # the swap must not split the decode step's jit cache key
+    assert eng.stats["decode_recompiles"] == recompiles0
+    # a reload from nowhere is refused verifiably, weights unchanged
+    code, body = _post(url, "/admin/reload",
+                       {"load": str(tmp_path / "missing")})
+    assert code == 409
+    assert _get(url, "/admin/status")[1]["weights_version"] == 3
+
+
+# ---------------------------------------------------------------------------
+# real-subprocess chaos suite (slow): the acceptance gates
+
+
+def _spec(tmp_path, name, **kw):
+    spec = {"preset": "tiny", "cfg": {"vocab_size": 64, "seq_length": 64},
+            "seed": 0, "engine_slots": 2, "port": 0, "warmup": True,
+            "port_file": str(tmp_path / f"{name}.port")}
+    spec.update(kw)
+    return spec
+
+
+def _spawn(tmp_path, name, fault="", **kw):
+    from megatron_tpu.inference.fleet.replica import ReplicaProcess
+
+    env = dict(os.environ, MEGATRON_TPU_FAULT=fault, JAX_PLATFORMS="cpu")
+    return ReplicaProcess(_spec(tmp_path, name, **kw), env=env,
+                          log_path=str(tmp_path / f"{name}.log")).spawn()
+
+
+def _wait_routable(router, n, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if router._num_routable() == n:
+            return True
+        time.sleep(0.1)
+    return False
+
+
+@pytest.mark.slow  # ~40s solo (two subprocess warmup compiles +
+# slowed-tick traffic + respawn); the fast router units + in-process
+# engine block keep dispatch, breaker, drain and reload logic in tier-1
+def test_chaos_sigkill_failover_and_readmit(tmp_path):
+    """SIGKILL one of 2 replicas mid-stream under concurrent traffic:
+    every request completes via failover (token-identical to the
+    survivor's solo answers), the router marks the replica dead, and a
+    respawn on the same port is readmitted by the prober."""
+    # r0 dies at decode tick 25 (mid-traffic: warmup costs ~2 ticks, each
+    # request ~16); slow ticks stretch requests so the kill lands
+    # mid-stream with several requests in flight
+    r0 = _spawn(tmp_path, "r0", fault="kill_replica:25,slow_tick:30")
+    r1 = _spawn(tmp_path, "r1", fault="slow_tick:30")
+    router = None
+    try:
+        r0.wait_ready(timeout=300)
+        r1.wait_ready(timeout=300)
+        prompts = [f"{3 + i} {4 + i} {5 + i}" for i in range(10)]
+        # greedy references from the survivor (identical seed weights on
+        # both replicas => any replica's solo answer is THE answer)
+        refs = {}
+        for p in prompts:
+            code, body = _post(r1.url, "/api",
+                               {"prompts": [p], "tokens_to_generate": 16,
+                                "temperature": 0.0})
+            assert code == 200
+            refs[p] = body["text"]
+
+        router = ReplicaRouter([r0.url, r1.url], probe_interval=0.2,
+                               request_timeout=60.0,
+                               metrics=MetricsRegistry()).start()
+        results = {}
+
+        def client(p):
+            body = json.dumps({"prompts": [p], "tokens_to_generate": 16,
+                               "temperature": 0.0}).encode()
+            results[p] = router.dispatch(body)
+
+        threads = [threading.Thread(target=client, args=(p,))
+                   for p in prompts]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=300)
+
+        # zero lost requests, token-identical to the solo run
+        for p in prompts:
+            status, _, rbody = results[p]
+            assert status == 200, (p, status, rbody)
+            assert json.loads(rbody)["text"] == refs[p]
+        # the kill really happened (SIGKILL, not a graceful exit)
+        deadline = time.monotonic() + 10
+        while r0.poll() is None and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert r0.poll() == -9, f"r0 rc={r0.poll()}"
+        assert _router_counter(router, "router_failovers_total") >= 1
+        # the prober marks the dead replica unroutable...
+        assert _wait_routable(router, 1), router.status()
+        # ...and readmits it after a respawn on the SAME port (pin the
+        # port BEFORE spawning so the router's URL stays valid)
+        from megatron_tpu.inference.fleet.replica import ReplicaProcess
+
+        r0b = ReplicaProcess(
+            _spec(tmp_path, "r0b", port=r0.port),
+            env=dict(os.environ, MEGATRON_TPU_FAULT="",
+                     JAX_PLATFORMS="cpu"),
+            log_path=str(tmp_path / "r0b.log"))
+        r0b.spawn()
+        try:
+            r0b.wait_ready(timeout=300)
+            assert _wait_routable(router, 2), router.status()
+            for p in prompts[:2]:
+                body = json.dumps({"prompts": [p],
+                                   "tokens_to_generate": 16,
+                                   "temperature": 0.0}).encode()
+                status, _, rbody = router.dispatch(body)
+                assert status == 200
+                assert json.loads(rbody)["text"] == refs[p]
+        finally:
+            r0b.close()
+    finally:
+        if router is not None:
+            router.close()
+        r0.close()
+        r1.close()
+
+
+@pytest.mark.slow  # ~120s: two subprocess warmups + live traffic through
+# a rolling update; the in-process hot-reload test keeps the
+# zero-recompile swap gate in tier-1
+def test_rolling_update_under_live_traffic(tmp_path):
+    """Ship new weights across the fleet under live traffic: zero dropped
+    requests, zero decode recompiles, and every response token-identical
+    to a solo run of whichever weight version served it."""
+    ckpts = tmp_path / "ckpts"
+    os.makedirs(ckpts)
+    save_params_checkpoint(str(ckpts), 1,
+                           init_params(CFG, jax.random.PRNGKey(1)))
+    save_params_checkpoint(str(ckpts), 2,
+                           init_params(CFG, jax.random.PRNGKey(2)))
+    r0 = _spawn(tmp_path, "r0", load=str(ckpts), iteration=1,
+                reload_dir=str(ckpts))
+    r1 = _spawn(tmp_path, "r1", load=str(ckpts), iteration=1,
+                reload_dir=str(ckpts))
+    router = None
+    prompts = [f"{5 + i} {6 + i}" for i in range(6)]
+
+    def solo_refs(url):
+        out = {}
+        for p in prompts:
+            code, body = _post(url, "/api",
+                               {"prompts": [p], "tokens_to_generate": 10,
+                                "temperature": 0.0})
+            assert code == 200
+            out[p] = body["text"]
+        return out
+
+    try:
+        r0.wait_ready(timeout=300)
+        r1.wait_ready(timeout=300)
+        refs = {1: solo_refs(r0.url)}
+        router = ReplicaRouter([r0.url, r1.url], probe_interval=0.2,
+                               request_timeout=60.0,
+                               metrics=MetricsRegistry()).start()
+        stop = threading.Event()
+        traffic = []
+
+        def worker(wid):
+            i = wid
+            while not stop.is_set():
+                p = prompts[i % len(prompts)]
+                i += 1
+                body = json.dumps({"prompts": [p],
+                                   "tokens_to_generate": 10,
+                                   "temperature": 0.0}).encode()
+                status, _, rbody = router.dispatch(body)
+                traffic.append((p, status, rbody))
+
+        workers = [threading.Thread(target=worker, args=(w,))
+                   for w in range(3)]
+        for th in workers:
+            th.start()
+        time.sleep(1.0)  # traffic flowing before the update starts
+        results = router.rolling_update(load=str(ckpts), iteration=2,
+                                        drain_timeout=60.0)
+        time.sleep(1.0)  # and after it finishes
+        stop.set()
+        for th in workers:
+            th.join(timeout=120)
+
+        assert len(results) == 2
+        for res in results:
+            assert "error" not in res, res
+            assert res["version"] == 2
+        refs[2] = solo_refs(r0.url)  # r0 now serves v2
+        assert refs[1] != refs[2]    # the versions genuinely differ
+
+        assert traffic, "no traffic flowed"
+        for p, status, rbody in traffic:
+            assert status == 200, (p, status, rbody)  # zero dropped
+            body = json.loads(rbody)
+            wv = body.get("weights_version")
+            # a drained update serves every request end-to-end on ONE
+            # version, and the response says which
+            assert wv in (1, 2), body
+            assert body["text"] == refs[wv][p], (p, wv)
+        # zero decode recompiles and exactly one swap per replica
+        for rep in (r0, r1):
+            samples = scrape.scrape(rep.url + "/metrics")
+            assert scrape.sample_value(
+                samples, "engine_decode_recompiles_total") == 0
+            assert scrape.sample_value(
+                samples, "engine_weight_reloads_total") == 1
+    finally:
+        if router is not None:
+            router.close()
+        r0.close()
+        r1.close()
+
+
+@pytest.mark.slow  # ~45s: one subprocess warmup compile; SIGTERM-drain
+# semantics (503 while draining, in-flight completion, rc=0)
+def test_graceful_drain_on_sigterm(tmp_path):
+    rep = _spawn(tmp_path, "r0", fault="slow_tick:100", drain_timeout=30.0)
+    try:
+        rep.wait_ready(timeout=300)
+        result = {}
+
+        def long_req():
+            result["r"] = _post(rep.url, "/api",
+                                {"prompts": ["5 6"],
+                                 "tokens_to_generate": 30})
+
+        th = threading.Thread(target=long_req)
+        th.start()
+        time.sleep(0.8)  # mid-decode at 100ms/tick
+        rep.terminate()
+        time.sleep(0.3)
+        code, body = _post(rep.url, "/api", {"prompts": ["4"],
+                                             "tokens_to_generate": 2})
+        assert code == 503 and body.get("draining"), (code, body)
+        th.join(timeout=60)
+        code, body = result["r"]
+        assert code == 200, (code, body)  # in-flight finished through drain
+        assert rep.wait(timeout=30) == 0  # clean exit after the drain
+    finally:
+        rep.close()
+
+
+@pytest.mark.slow  # ~40s: one subprocess warmup; hang_replica wedges the
+# step loop — only readiness (progress stall) may flip, liveness stays up
+def test_hung_replica_flips_readiness_not_liveness(tmp_path):
+    rep = _spawn(tmp_path, "r0", fault="hang_replica:8,slow_tick:30",
+                 stall_threshold_s=0.5)
+    try:
+        rep.wait_ready(timeout=300)
+
+        def doomed():
+            try:
+                _post(rep.url, "/api", {"prompts": ["3 4"],
+                                        "tokens_to_generate": 30},
+                      timeout=5)
+            except (OSError, urllib.error.URLError):
+                pass  # the request never completes — that's the point
+
+        threading.Thread(target=doomed, daemon=True).start()
+        deadline = time.monotonic() + 30
+        stalled = None
+        while time.monotonic() < deadline:
+            code, body = _get(rep.url, "/readyz")
+            if code == 503 and body.get("stalled"):
+                stalled = body
+                break
+            time.sleep(0.2)
+        assert stalled, "readiness never flagged the hung step loop"
+        # liveness can't see a hang: the thread is alive, just wedged —
+        # exactly why the router keys off /readyz
+        assert _get(rep.url, "/healthz")[0] == 200
+        assert rep.poll() is None
+    finally:
+        rep.close()
+
+
+@pytest.mark.slow  # ~110s: paged-engine variant of the SIGKILL failover
+# (fleet logic proven against both engines, ISSUE satellite)
+def test_chaos_failover_paged_engine(tmp_path):
+    r0 = _spawn(tmp_path, "r0", fault="kill_replica:20,slow_tick:30",
+                kv_paging=True, page_size=8, prefill_chunk=8)
+    r1 = _spawn(tmp_path, "r1", fault="slow_tick:30",
+                kv_paging=True, page_size=8, prefill_chunk=8)
+    router = None
+    try:
+        r0.wait_ready(timeout=300)
+        r1.wait_ready(timeout=300)
+        prompts = [f"{3 + i} {4 + i} {5 + i}" for i in range(6)]
+        refs = {}
+        for p in prompts:
+            code, body = _post(r1.url, "/api",
+                               {"prompts": [p], "tokens_to_generate": 12,
+                                "temperature": 0.0})
+            assert code == 200
+            refs[p] = body["text"]
+        router = ReplicaRouter([r0.url, r1.url], probe_interval=0.2,
+                               request_timeout=60.0,
+                               metrics=MetricsRegistry()).start()
+        results = {}
+
+        def client(p):
+            body = json.dumps({"prompts": [p], "tokens_to_generate": 12,
+                               "temperature": 0.0}).encode()
+            results[p] = router.dispatch(body)
+
+        threads = [threading.Thread(target=client, args=(p,))
+                   for p in prompts]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=300)
+        for p in prompts:
+            status, _, rbody = results[p]
+            assert status == 200, (p, status, rbody)
+            assert json.loads(rbody)["text"] == refs[p]
+        deadline = time.monotonic() + 10
+        while r0.poll() is None and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert r0.poll() == -9
+    finally:
+        if router is not None:
+            router.close()
+        r0.close()
+        r1.close()
+
+
+@pytest.mark.slow  # ~60s: two in-process engine compiles + a ~6s replay;
+# the SLO math itself is tier-1 (test_slo_trace_deterministic...)
+def test_serve_slo_bench_line_reports_percentiles():
+    import bench
+
+    line = bench.serve_slo_bench(time.perf_counter() + 240)
+    assert "error" not in line, line
+    d = line["detail"]
+    assert d["failed"] == 0 and d["completed"] == d["requests"]
+    assert line["value"] > 0
+    for key in ("ttft_s", "tpot_s", "client_wall_s"):
+        for q in ("p50", "p95", "p99"):
+            v = d[key][q]
+            assert v == v and v >= 0, (key, q, v)  # finite, not NaN

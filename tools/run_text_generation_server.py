@@ -59,6 +59,22 @@ def extra_args(parser):
                         "beyond this many waiters get HTTP 503 + "
                         "Retry-After instead of unbounded queue latency "
                         "(default: unbounded)")
+    g.add_argument("--serve_request_timeout", type=float, default=None,
+                   help="per-request deadline in seconds (engine path): a "
+                        "queued or mid-decode request past it fails with "
+                        "HTTP 504 instead of waiting forever — bounds the "
+                        "fleet router's retry worst case (default: no "
+                        "deadline; a request's own deadline_s field may "
+                        "shorten this but never extend past it)")
+    g.add_argument("--serve_drain_timeout", type=float, default=30.0,
+                   help="graceful-drain budget on SIGTERM/SIGINT: stop "
+                        "admitting (503 + Retry-After), wait up to this "
+                        "many seconds for in-flight requests, then exit; "
+                        "a second signal force-exits immediately")
+    g.add_argument("--serve_warmup", action="store_true",
+                   help="compile the decode step before /readyz goes "
+                        "green, so a fleet router or k8s-style prober "
+                        "never routes a request into the warmup compile")
     g.add_argument("--kv_cache_int8", action="store_true",
                    help="serve with an int8-quantized KV cache (half the "
                         "cache HBM -> 2x context/batch per chip)")
@@ -93,10 +109,11 @@ def main(argv=None):
         new_tokens=args.new_tokens)
 
     params = init_params(cfg.model, jax.random.PRNGKey(cfg.training.seed))
+    weights_version = None
     if cfg.training.load:
         params = checkpointing.load_params_only(cfg.training.load, params)
-        print(f"loaded checkpoint at iteration "
-              f"{checkpointing.read_tracker(cfg.training.load)}")
+        weights_version = checkpointing.read_tracker(cfg.training.load)
+        print(f"loaded checkpoint at iteration {weights_version}")
     else:
         print("WARNING: serving randomly initialized weights (no --load)")
 
@@ -179,7 +196,12 @@ def main(argv=None):
                kv_paging=args.serve_kv_paging,
                page_size=args.serve_page_size,
                prefill_chunk=args.serve_prefill_chunk,
-               num_pages=args.serve_num_pages)
+               num_pages=args.serve_num_pages,
+               request_timeout=args.serve_request_timeout,
+               drain_timeout=args.serve_drain_timeout,
+               warmup=args.serve_warmup,
+               reload_dir=cfg.training.load or None,
+               weights_version=weights_version)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Traffic-replay SLO harness for the serving fleet (docs/serving.md).
+
+Replays a deterministic request trace at a fixed offered load against a
+front door (the fleet router, or a single replica) and reports TTFT/TPOT
+p50/p95/p99 from the replicas' telemetry histograms plus client-side wall
+percentiles — measured SLOs under load, not anecdotes.
+
+Attach to a live fleet:
+
+  python tools/slo_harness.py --api http://127.0.0.1:8000 \
+      --replica http://127.0.0.1:5001 --replica http://127.0.0.1:5002 \
+      --requests 64 --offered_rps 4
+
+or spawn a throwaway local fleet of tiny deterministic replicas first
+(CPU-friendly; the shape the fleet tests use):
+
+  python tools/slo_harness.py --spawn 2 --requests 64 --offered_rps 4
+
+Output is one JSON report on stdout (percentiles in seconds). The
+`serve_slo_offered_load` bench.py line is this harness inlined.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(
+        description="offered-load SLO replay against a serving fleet")
+    ap.add_argument("--api", default=None,
+                    help="front-door URL (router or replica). Omit with "
+                         "--spawn to build a local fleet")
+    ap.add_argument("--replica", action="append", default=[],
+                    help="replica base URL (repeatable) — /metrics is "
+                         "scraped for TTFT/TPOT histograms; defaults to "
+                         "--api when omitted")
+    ap.add_argument("--spawn", type=int, default=0,
+                    help="spawn N tiny local replicas + a router and "
+                         "replay against that (ignores --api/--replica)")
+    ap.add_argument("--requests", type=int, default=32)
+    ap.add_argument("--offered_rps", type=float, default=4.0)
+    ap.add_argument("--new_tokens", type=int, default=16)
+    ap.add_argument("--vocab", type=int, default=64,
+                    help="prompt token id bound (NullTokenizer-style "
+                         "integer prompts)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--timeout", type=float, default=120.0,
+                    help="per-request client timeout")
+    ap.add_argument("--engine_slots", type=int, default=2,
+                    help="slots per spawned replica (--spawn)")
+    return ap.parse_args(argv)
+
+
+def run_attached(args) -> dict:
+    from megatron_tpu.inference.fleet import slo
+
+    trace = slo.make_trace(args.requests, args.offered_rps,
+                           seed=args.seed, vocab=args.vocab,
+                           new_tokens=args.new_tokens)
+    metrics_urls = [u.rstrip("/") + "/metrics"
+                    for u in (args.replica or [args.api])]
+    return slo.run_slo(args.api.rstrip("/") + "/api", metrics_urls, trace,
+                       args.offered_rps, timeout=args.timeout)
+
+
+def run_spawned(args) -> dict:
+    from megatron_tpu.inference.fleet import slo
+    from megatron_tpu.inference.fleet.replica import ReplicaProcess
+    from megatron_tpu.inference.fleet.router import RouterServer
+
+    with tempfile.TemporaryDirectory(prefix="slo_fleet_") as tmp:
+        replicas = []
+        try:
+            for i in range(args.spawn):
+                spec = {"preset": "tiny",
+                        "cfg": {"vocab_size": args.vocab, "seq_length": 64},
+                        "seed": 0, "engine_slots": args.engine_slots,
+                        "port": 0, "warmup": True,
+                        "port_file": os.path.join(tmp, f"r{i}.port")}
+                replicas.append(ReplicaProcess(
+                    spec, log_path=os.path.join(tmp, f"r{i}.log")).spawn())
+            for rep in replicas:
+                rep.wait_ready(timeout=300)
+            router = RouterServer([r.url for r in replicas]).start()
+            try:
+                trace = slo.make_trace(args.requests, args.offered_rps,
+                                       seed=args.seed, vocab=args.vocab,
+                                       new_tokens=args.new_tokens)
+                report = slo.run_slo(
+                    router.url + "/api",
+                    [r.url + "/metrics" for r in replicas], trace,
+                    args.offered_rps, timeout=args.timeout)
+                report["spawned_replicas"] = args.spawn
+                return report
+            finally:
+                router.close()
+        finally:
+            for rep in replicas:
+                rep.close()
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    if not args.spawn and not args.api:
+        print("need --api URL (attach) or --spawn N (local fleet)",
+              file=sys.stderr)
+        return 2
+    report = run_spawned(args) if args.spawn else run_attached(args)
+    print(json.dumps(report, indent=2))
+    return 0 if report.get("failed", 1) == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

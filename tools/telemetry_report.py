@@ -144,6 +144,63 @@ def summarize(events: List[Dict[str, Any]], top_n: int = 5) -> Dict[str, Any]:
             out["data_wait_ms"] = {"p50": round(percentile(waits, 0.50), 3),
                                    "p99": round(percentile(waits, 0.99), 3),
                                    "max": round(waits[-1], 3)}
+    serving = _summarize_serving(events)
+    if serving:
+        out["serving"] = serving
+    return out
+
+
+def _summarize_serving(events: List[Dict[str, Any]]
+                       ) -> Dict[str, Any]:
+    """Serving section (docs/serving.md "Fleet"): per-request TTFT/TPOT
+    percentiles off the engine's `serve_request` events, the router's
+    retry/failover ledger off `serve_route`, and the fleet lifecycle
+    counters (breaker opens, readmits, drains, weight reloads)."""
+    reqs = [e for e in events if e.get("kind") == "serve_request"]
+    routes = [e for e in events if e.get("kind") == "serve_route"]
+    out: Dict[str, Any] = {}
+    if reqs:
+        by_status: Dict[str, int] = {}
+        for e in reqs:
+            s = str(e.get("status", "?"))
+            by_status[s] = by_status.get(s, 0) + 1
+        out["requests"] = {"total": len(reqs), "by_status": by_status}
+        for field, label in (("ttft_s", "ttft_s"), ("tpot_s", "tpot_s"),
+                             ("wall_s", "request_wall_s")):
+            vals = sorted(float(e[field]) for e in reqs if field in e)
+            if vals:
+                out[label] = {"p50": round(percentile(vals, 0.50), 4),
+                              "p95": round(percentile(vals, 0.95), 4),
+                              "p99": round(percentile(vals, 0.99), 4)}
+    if routes:
+        retries = sum(max(0, int(e.get("attempts", 1)) - 1) for e in routes)
+        failovers = sum(1 for e in routes
+                        if int(e.get("attempts", 1)) > 1
+                        and int(e.get("status", 0)) == 200)
+        out["router"] = {
+            "routed": len(routes),
+            "retries": retries,
+            "failovers": failovers,
+            "exhausted": sum(1 for e in routes if e.get("exhausted")),
+        }
+    lifecycle = {
+        "breaker_opens": sum(1 for e in events
+                             if e.get("kind") == "replica_breaker_open"),
+        "readmits": sum(1 for e in events
+                        if e.get("kind") == "replica_readmitted"),
+        "drains": sum(1 for e in events
+                      if e.get("kind") == "serve_drain_begin"),
+        # one /admin/reload emits BOTH kinds (engine swap + service
+        # record) into the same journal; engine-less (one-shot) servers
+        # emit only serve_weight_reload and bare update_params callers
+        # only weight_reload — max() counts each reload once either way
+        "weight_reloads": max(
+            sum(1 for e in events if e.get("kind") == "weight_reload"),
+            sum(1 for e in events
+                if e.get("kind") == "serve_weight_reload")),
+    }
+    if any(lifecycle.values()):
+        out["fleet"] = lifecycle
     return out
 
 
@@ -180,6 +237,30 @@ def render(summary: Dict[str, Any]) -> str:
             "(warm persistent cache)")
     if summary.get("last_loss") is not None:
         lines.append(f"last loss: {summary['last_loss']}")
+    if "serving" in summary:
+        sv = summary["serving"]
+        if "requests" in sv:
+            r = sv["requests"]
+            lines.append(f"serving: {r['total']} requests "
+                         f"{r['by_status']}")
+        for key, label in (("ttft_s", "ttft s"), ("tpot_s", "tpot s"),
+                           ("request_wall_s", "request wall s")):
+            if key in sv:
+                p = sv[key]
+                lines.append(f"  {label}: p50 {p['p50']} | "
+                             f"p95 {p['p95']} | p99 {p['p99']}")
+        if "router" in sv:
+            r = sv["router"]
+            lines.append(f"  router: {r['routed']} routed | "
+                         f"{r['retries']} retries | "
+                         f"{r['failovers']} failovers | "
+                         f"{r['exhausted']} exhausted")
+        if "fleet" in sv:
+            f = sv["fleet"]
+            lines.append(f"  fleet: {f['breaker_opens']} breaker opens | "
+                         f"{f['readmits']} readmits | "
+                         f"{f['drains']} drains | "
+                         f"{f['weight_reloads']} weight reloads")
     if summary.get("faults"):
         lines.append(f"injected faults: {summary['faults']}")
     if summary.get("divergences"):
