@@ -82,6 +82,15 @@ CONFIGS: Dict[str, Callable[[], Any]] = {
     # indexing is a whole new code path (inference/paging/)
     "decode_paged": lambda: _targets().paged_decode_step_target(
         "decode_paged"),
+    # speculative decode step (model drafter): draft-proposal scan +
+    # multi-token verify + in-step accept/reject. Zero collectives,
+    # zero callbacks, BOTH cache trees (target + draft) donated
+    "decode_spec": lambda: _targets().spec_decode_step_target(
+        "decode_spec"),
+    # paged speculative decode step: same contract through the page-
+    # table indirection (one table addresses both pools)
+    "decode_spec_paged": lambda: _targets().spec_paged_decode_step_target(
+        "decode_spec_paged"),
 }
 
 

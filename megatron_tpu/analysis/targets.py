@@ -180,6 +180,82 @@ def paged_decode_step_target(name: str = "decode_paged",
     return AuditTarget(name=name, fn=eng._decode_step, args=args)
 
 
+def spec_decode_step_target(name: str = "decode_spec",
+                            dtype: str = "bfloat16",
+                            num_slots: int = 4, k: int = 3) -> AuditTarget:
+    """The speculative decode step (inference/speculative.py), model
+    drafter: k-step draft-proposal scan + one [N, k+1] target verify +
+    in-step accept/reject. Contract: ZERO collectives, ZERO host
+    callbacks (the accept math must stay on device), and FULL donation
+    of BOTH cache trees (target and draft)."""
+    from megatron_tpu.inference.engine import InferenceEngine
+    from megatron_tpu.inference.speculative import SpecConfig
+    from megatron_tpu.models.params import init_params
+
+    cfg = tiny_model(params_dtype=dtype)
+    dcfg = tiny_model(params_dtype=dtype, num_layers=2)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    dparams = init_params(dcfg, jax.random.PRNGKey(1))
+    eng = InferenceEngine(
+        cfg, params, num_slots=num_slots, max_seq_len=cfg.seq_length,
+        force_donate=True,
+        speculative=SpecConfig(k=k, drafter="model", draft_cfg=dcfg,
+                               draft_params=dparams))
+    N = num_slots
+    args = (
+        _sds(params),
+        _sds(eng.caches),
+        _sds(dparams),
+        _sds(eng.draft_caches),
+        jax.ShapeDtypeStruct((N,), jnp.int32),      # last_tok
+        jax.ShapeDtypeStruct((N,), jnp.int32),      # lengths
+        jax.ShapeDtypeStruct((N, 2), jnp.uint32),   # keys
+        jax.ShapeDtypeStruct((N,), jnp.float32),    # temps
+        jax.ShapeDtypeStruct((N,), jnp.int32),      # top_ks
+        jax.ShapeDtypeStruct((N,), jnp.float32),    # top_ps
+        jax.ShapeDtypeStruct((N,), jnp.bool_),      # spec_rows
+    )
+    return AuditTarget(name=name, fn=eng._spec_step, args=args)
+
+
+def spec_paged_decode_step_target(name: str = "decode_spec_paged",
+                                  dtype: str = "bfloat16",
+                                  num_slots: int = 4,
+                                  k: int = 3) -> AuditTarget:
+    """The paged speculative decode step: the same contract as
+    decode_spec with the page-table indirection on BOTH cache trees
+    (target pools and draft pools share one table)."""
+    from megatron_tpu.inference.paging import PagedInferenceEngine
+    from megatron_tpu.inference.speculative import SpecConfig
+    from megatron_tpu.models.params import init_params
+
+    cfg = tiny_model(params_dtype=dtype)
+    dcfg = tiny_model(params_dtype=dtype, num_layers=2)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    dparams = init_params(dcfg, jax.random.PRNGKey(1))
+    eng = PagedInferenceEngine(
+        cfg, params, num_slots=num_slots, max_seq_len=cfg.seq_length,
+        page_size=8, prefill_chunk=16, force_donate=True,
+        speculative=SpecConfig(k=k, drafter="model", draft_cfg=dcfg,
+                               draft_params=dparams))
+    N = num_slots
+    args = (
+        _sds(params),
+        _sds(eng.caches),
+        _sds(dparams),
+        _sds(eng.draft_caches),
+        jax.ShapeDtypeStruct((N, eng.max_pages), jnp.int32),  # page table
+        jax.ShapeDtypeStruct((N,), jnp.int32),      # last_tok
+        jax.ShapeDtypeStruct((N,), jnp.int32),      # lengths
+        jax.ShapeDtypeStruct((N, 2), jnp.uint32),   # keys
+        jax.ShapeDtypeStruct((N,), jnp.float32),    # temps
+        jax.ShapeDtypeStruct((N,), jnp.int32),      # top_ks
+        jax.ShapeDtypeStruct((N,), jnp.float32),    # top_ps
+        jax.ShapeDtypeStruct((N,), jnp.bool_),      # spec_rows
+    )
+    return AuditTarget(name=name, fn=eng._spec_step, args=args)
+
+
 # ---------------------------------------------------------------------------
 # op-level bodies: ring / ulysses / moe
 # ---------------------------------------------------------------------------

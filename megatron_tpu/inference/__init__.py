@@ -10,8 +10,10 @@ from megatron_tpu.inference.api import (
     beam_search_and_post_process,
 )
 from megatron_tpu.inference.engine import InferenceEngine, Request
+from megatron_tpu.inference.speculative import SpecConfig
 
 __all__ = [
+    "SpecConfig",
     "sample_logits",
     "sample_logits_batched",
     "GenerationOutput",

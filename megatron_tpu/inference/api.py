@@ -58,6 +58,7 @@ def generate_and_post_process(
     kv_cache_int8: bool = False,
     engine=None,
     deadline_s=None,
+    spec: bool = True,
 ):
     """(texts, segments, logprobs, tokens) like the reference's
     generate_and_post_process (api.py:19-90). forward_fn plugs in the
@@ -67,7 +68,9 @@ def generate_and_post_process(
     slot scheduler lets concurrent callers share decode steps.
     deadline_s (engine path only) bounds each request's total wall time:
     past it the engine fails the request with RequestTimeoutError
-    (HTTP 504) instead of leaving the caller waiting."""
+    (HTTP 504) instead of leaving the caller waiting. spec=False pins
+    the request to plain one-token-per-tick decode on a speculating
+    engine (no-op otherwise); greedy output is identical either way."""
     if tokens_to_generate < 0:
         raise ValueError("tokens_to_generate must be >= 0")
     prompt_tokens, lengths = tokenize_prompts(tokenizer, prompts,
@@ -94,7 +97,7 @@ def generate_and_post_process(
             prompt_tokens, lengths, max_new_tokens=tokens_to_generate,
             temperature=temperature, top_k=top_k_sampling,
             top_p=top_p_sampling, eod=tokenizer.eod, seed=random_seed,
-            deadline_s=deadline_s)
+            deadline_s=deadline_s, spec=spec)
     else:
         out = generate_tokens(
             cfg, params, prompt_tokens, lengths,

@@ -82,6 +82,12 @@ class Request:
     # engine-filled
     generated: List[int] = dataclasses.field(default_factory=list)
     logprobs: List[float] = dataclasses.field(default_factory=list)
+    # per-request speculative-decoding knob: False pins this request to
+    # one token per tick even on a speculating engine (its greedy output
+    # is bit-identical either way; the knob exists for traffic classes
+    # that want the lowest per-token latency variance). Ignored when the
+    # engine was built without `speculative=`.
+    spec: bool = True
     # preemption/resume (paged engine): the PRNG chain state at
     # preemption, so a recompute-resumed request samples the exact
     # tokens it would have sampled without the preemption
@@ -129,7 +135,8 @@ class InferenceEngine:
                  metrics: Optional[MetricsRegistry] = None,
                  flight_recorder=None,
                  force_donate: Optional[bool] = None,
-                 max_queue: Optional[int] = None):
+                 max_queue: Optional[int] = None,
+                 speculative=None):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
         if max_queue is not None and max_queue < 1:
@@ -164,6 +171,24 @@ class InferenceEngine:
         # and pay one recompile (the smoke test caught exactly that)
         self.params = self._commit(self.params)
         self.caches = self._commit(self._fresh_caches())
+        # speculative decoding (inference/speculative.py): k drafted
+        # tokens per slot verified by ONE [N, k+1] target forward per
+        # tick, exact accept/reject inside the jitted step. The draft-
+        # model drafter keeps a SECOND cache tree threaded through the
+        # same slot/page machinery as the target's.
+        self.spec = speculative
+        self.draft_params = None
+        self.draft_caches = None
+        self._spec_step = None
+        self.spec_on = np.ones(N, bool)   # per-request knob mirror
+        self._spec_rows_dev = None        # committed device copy
+        if speculative is not None:
+            from megatron_tpu.inference.speculative import validate_spec
+
+            validate_spec(cfg, speculative)
+            if speculative.drafter == "model":
+                self.draft_params = self._commit(speculative.draft_params)
+                self.draft_caches = self._commit(self._fresh_draft_caches())
         self.slots: List[Optional[Request]] = [None] * N
         self.lengths = np.zeros(N, np.int32)    # valid context per slot
         self.last_tok = np.zeros(N, np.int32)   # sampled, not yet in cache
@@ -196,7 +221,10 @@ class InferenceEngine:
         self.last_progress_time = time.monotonic()
 
         self._decode_step = self._build_decode_step()
+        if self.spec is not None:
+            self._spec_step = self._build_spec_step()
         self._prefill_steps = {}  # bucketed prompt length -> jitted fn
+        self._draft_prefill_steps = {}  # same buckets, draft cache writes
         # observability for tests/metrics: monotonically-growing counters.
         # decode_recompiles counts decode-step compiles BEYOND the warmup
         # one — the "zero recompiles after warmup" invariant (PR 1) as a
@@ -204,6 +232,12 @@ class InferenceEngine:
         self.stats = {"admitted": 0, "retired": 0, "ticks": 0,
                       "rejected": 0, "decode_recompiles": 0,
                       "timeouts": 0, "weight_reloads": 0}
+        if self.spec is not None:
+            # spec_emitted counts every token the spec path emitted
+            # (accepted drafts + the guaranteed token per row per tick);
+            # spec_emitted / ticks = effective tokens per target forward
+            self.stats.update({"spec_proposed": 0, "spec_accepted": 0,
+                               "spec_emitted": 0})
         self._decode_cache_seen = 0  # compiles observed on _decode_step
 
         # Prometheus collectors (megatron_tpu/telemetry): shared with the
@@ -247,6 +281,16 @@ class InferenceEngine:
                                       "admission prefill wall time")
         self._m_tick = m.histogram("engine_decode_tick_seconds",
                                    "batched decode tick wall time")
+        self._m_spec_proposed = m.counter(
+            "engine_spec_proposed_total",
+            "draft tokens proposed to the speculative verify step")
+        self._m_spec_accepted = m.counter(
+            "engine_spec_accepted_total",
+            "draft tokens accepted by the exact accept/reject")
+        self._m_spec_len = m.histogram(
+            "engine_spec_accept_length",
+            "accepted drafts per slot per tick (0..k)",
+            buckets=(0.5, 1.5, 2.5, 3.5, 4.5, 6.5, 8.5, 12.5, 16.5))
         self._m_slots.set(num_slots)
 
     # ----- cache + shape policy -------------------------------------------
@@ -283,6 +327,31 @@ class InferenceEngine:
         to build page pools instead of per-slot rows)."""
         return _init_caches(self.cfg, self.num_slots, self.max_seq_len,
                             int8=self.kv_cache_int8)
+
+    def _fresh_draft_caches(self):
+        """The draft model's second cache tree (speculative decoding,
+        drafter='model'): same slots and length as the target cache,
+        the draft config's own layer/head geometry, always bf16/f32 —
+        the draft is small, quantizing it would buy little and cost a
+        second quantization seam. Paged engine overrides with pools."""
+        return _init_caches(self.spec.draft_cfg, self.num_slots,
+                            self.max_seq_len, int8=False)
+
+    def _rebuild_caches(self):
+        """Replace every donated cache tree after a failed device call
+        may have consumed the old buffers (prefill/decode failure
+        recovery). Cached prefixes and draft state die with them."""
+        self.caches = self._commit(self._fresh_caches())
+        if self.draft_caches is not None:
+            self.draft_caches = self._commit(self._fresh_draft_caches())
+
+    def _capacity_margin(self) -> int:
+        """Sequence-capacity headroom a speculating engine reserves: a
+        tick writes K/V at positions length..length+k, so the LAST tick
+        of a request (length = prompt + max_new - 1) must still fit k
+        more positions — admission rejects prompt + max_new past
+        max_seq_len - k. 0 when speculation is off."""
+        return self.spec.k if self.spec is not None else 0
 
     # ----- jitted device steps --------------------------------------------
 
@@ -338,6 +407,61 @@ class InferenceEngine:
             return toks, lp, caches, new_keys, lengths + 1
 
         return decode_step
+
+    # ----- speculative decoding (inference/speculative.py) ----------------
+
+    def _has_draft_model(self) -> bool:
+        return self.spec is not None and self.spec.drafter == "model"
+
+    def _spec_donate(self):
+        """Donated argnums for the speculative step: the target cache
+        tree, plus the draft cache tree for the model drafter (both are
+        persistent engine state updated in place every tick)."""
+        if not self._donate():
+            return ()
+        return (1, 3) if self._has_draft_model() else (1,)
+
+    def _spec_paged(self) -> bool:
+        """Whether the spec step threads a page table (overridden by the
+        paged engine)."""
+        return False
+
+    def _build_spec_step(self):
+        from megatron_tpu.inference.speculative import build_spec_decode_step
+
+        return build_spec_decode_step(
+            self.cfg, self.spec, self.vocab_size, self.want_logprobs,
+            self._spec_donate(), paged=self._spec_paged())
+
+    def _draft_prefill_step(self, P: int):
+        """Jitted draft-cache prefill at bucket length P (model drafter
+        only): write the prompt's K/V into the draft tree so the first
+        spec tick's proposal scan sees the full context. No sampling —
+        the draft never emits tokens directly."""
+        fn = self._draft_prefill_steps.get(P)
+        if fn is not None:
+            return fn
+        dcfg = self.spec.draft_cfg
+        from functools import partial
+
+        from megatron_tpu.models.language_model import lm_forward
+
+        @partial(jax.jit, donate_argnums=self._donate())
+        def draft_prefill(dparams, dcaches, tokens, slot):
+            small = _init_caches(dcfg, 1, P, int8=False)
+            _, small = lm_forward(dcfg, dparams, tokens,
+                                  positions=jnp.arange(P)[None, :],
+                                  kv_caches=small, cache_index=0)
+
+            def paste(big, sm):
+                idx = (0, slot) + (0,) * (big.ndim - 2)
+                return jax.lax.dynamic_update_slice(
+                    big, sm.astype(big.dtype), idx)
+
+            return jax.tree.map(paste, dcaches, small)
+
+        self._draft_prefill_steps[P] = draft_prefill
+        return draft_prefill
 
     def _prefill_step(self, P: int):
         """Jitted prefill at static bucket length P (compiled once per
@@ -404,10 +528,13 @@ class InferenceEngine:
             self.stats["rejected"] += 1
             self._m_rejected.inc()
             return req
-        if p + req.max_new_tokens > self.max_seq_len:
+        margin = self._capacity_margin()
+        if p + req.max_new_tokens > self.max_seq_len - margin:
             req._finish(
                 f"prompt ({p}) + max_new_tokens ({req.max_new_tokens}) "
-                f"exceeds engine max_seq_len {self.max_seq_len}")
+                f"exceeds engine max_seq_len {self.max_seq_len}"
+                + (f" minus the speculative headroom {margin}"
+                   if margin else ""))
             self.stats["rejected"] += 1
             self._m_rejected.inc()
             return req
@@ -455,13 +582,23 @@ class InferenceEngine:
     def _clear_slot(self, i: int):
         """Reset EVERY per-slot host mirror — a cleared slot must not
         leave sampling knobs behind, or the next carry upload would keep
-        the batched sampler's filter branch live for stale rows."""
+        the batched sampler's filter branch live for stale rows. (This
+        is the whole of the retire-path knob hygiene: every retire /
+        timeout / preempt / stop path funnels through here, and the
+        paired _sync_carry at each call site drops the device carry
+        that still holds the old knobs — audited again for the
+        speculative rollback path, whose accept/reject cond reads the
+        same temps/top_ks/top_ps rows; regression-pinned by
+        test_speculative.py's all-greedy filter-dead test.)"""
         self.slots[i] = None
         self.lengths[i] = 0
         self.last_tok[i] = 0
         self.temps[i] = 0.0
         self.top_ks[i] = 0
         self.top_ps[i] = 0.0
+        if not self.spec_on[i]:
+            self.spec_on[i] = True
+            self._spec_rows_dev = None
 
     def _retire(self, i: int):
         req = self.slots[i]
@@ -528,6 +665,13 @@ class InferenceEngine:
                 jnp.int32(p), jnp.int32(i), jax.random.PRNGKey(req.seed),
                 jnp.float32(req.temperature), jnp.int32(req.top_k),
                 jnp.float32(req.top_p))
+            self.caches = caches
+            if self._has_draft_model():
+                # mirror the prompt into the draft model's cache tree so
+                # the first speculative tick proposes with full context
+                self.draft_caches = self._draft_prefill_step(P)(
+                    self.draft_params, self.draft_caches,
+                    jnp.asarray(toks), jnp.int32(i))
         except Exception as e:  # noqa: BLE001 - a failing prefill
             # (fresh-bucket compile OOM etc.) must fail THIS request,
             # not strand it un-signalled and kill the step loop
@@ -539,15 +683,14 @@ class InferenceEngine:
                 # buffers — continuing would poison every active slot
                 # at the next decode tick (step() has the matching
                 # recovery); fail the in-flight requests and restart
-                # from a fresh cache
+                # from fresh caches (target AND draft trees)
                 for j, other in enumerate(self.slots):
                     if other is not None:
                         self._clear_slot(j)
                         other._finish(f"prefill failed: {e}")
-                self.caches = self._commit(self._fresh_caches())
+                self._rebuild_caches()
                 self._m_active.set(self.num_active)
             return 0
-        self.caches = caches
         self.slots[i] = req
         self.lengths[i] = p
         self.last_tok[i] = int(tok)
@@ -555,6 +698,9 @@ class InferenceEngine:
         self.top_ks[i] = req.top_k
         self.top_ps[i] = req.top_p
         self.keys[i] = np.asarray(key)
+        if self.spec is not None:
+            self.spec_on[i] = bool(req.spec)
+            self._spec_rows_dev = None
         req.generated.append(int(tok))
         req.logprobs.append(float(lp))
         req.prompt_logprobs = [float(x) for x in plp[:p - 1]]
@@ -727,6 +873,16 @@ class InferenceEngine:
                         (now - req.first_token_time)
                         / (len(req.generated) - 1), 6)
         j.emit("serve_request", **fields)
+        if self.spec is not None:
+            # cumulative speculative counters, one snapshot per retired
+            # request (like goodput's cumulative records): the report
+            # reads the LAST one for accept rate / tokens-per-forward
+            j.emit("serve_spec",
+                   proposed=self.stats["spec_proposed"],
+                   accepted=self.stats["spec_accepted"],
+                   emitted=self.stats["spec_emitted"],
+                   ticks=self.stats["ticks"], k=self.spec.k,
+                   drafter=self.spec.drafter)
 
     def _decode_rows(self):
         """Slot indices the batched decode serves this tick (the paged
@@ -739,12 +895,44 @@ class InferenceEngine:
         table here)."""
         return ()
 
-    def _decode_tick(self) -> int:
-        """One batched decode for every decodable slot; returns how many
-        were served (0 = nothing to decode)."""
-        active = self._decode_rows()
-        if not active:
-            return 0
+    def _decode_write_span(self) -> int:
+        """Cache positions one decode tick writes per slot: 1 plain,
+        k+1 speculative (the paged engine sizes page allocation off
+        this)."""
+        return 1 + self._capacity_margin()
+
+    def _spec_rows_arg(self):
+        """Committed device copy of the per-request spec knob mask
+        (same caching pattern as the paged engine's device table — a
+        fresh host upload every tick would flip the arg's committedness
+        and split the jit cache key)."""
+        if self._spec_rows_dev is None:
+            self._spec_rows_dev = self._commit(jnp.asarray(self.spec_on))
+        return self._spec_rows_dev
+
+    def _propose_ngram(self) -> np.ndarray:
+        """Host-side prompt-lookup proposals for every slot (drafter
+        'ngram'): [N, k] int32, zeros for idle / spec-off rows (their
+        drafts are dead — acceptance is forced to 0)."""
+        from megatron_tpu.inference.speculative import ngram_propose
+
+        k, n = self.spec.k, self.spec.ngram
+        drafts = np.zeros((self.num_slots, k), np.int32)
+        for i in range(self.num_slots):
+            req = self.slots[i]
+            if req is None or not self.spec_on[i]:
+                continue
+            drafts[i] = ngram_propose(
+                np.concatenate([np.asarray(req.prompt, np.int32),
+                                np.asarray(req.generated, np.int32)]),
+                k, n)
+        return drafts
+
+    def _init_carry(self):
+        """The device-resident decode carry, (re)built from the host
+        mirrors after an admission/retire invalidated it — shared by
+        the plain and speculative ticks (ONE layout; a carry change
+        must hit both paths by construction)."""
         if self._carry is None:
             self._carry = self._commit(
                 (jnp.asarray(self.last_tok),
@@ -753,23 +941,111 @@ class InferenceEngine:
                  jnp.asarray(self.temps),
                  jnp.asarray(self.top_ks),
                  jnp.asarray(self.top_ps)))
-        last, lens, keys, temps, top_ks, top_ps = self._carry
+        return self._carry
+
+    def _fail_decode(self, active, e) -> None:
+        """Decode-step failure recovery shared by the plain and
+        speculative ticks: fail the in-flight requests (their waiters
+        must unblock), drop the carry, and restore usable caches —
+        donation may have consumed every cache tree."""
+        for i in active:
+            req = self.slots[i]
+            self._clear_slot(i)
+            req._finish(f"decode step failed: {e}")
+        self._m_active.set(self.num_active)
+        self._carry = None
+        self._rebuild_caches()
+
+    def _decode_tick_spec(self, active) -> int:
+        """One speculative decode tick: propose k drafts per slot
+        (host n-gram lookup, or the in-step draft-model scan), ONE
+        [N, k+1] target verify forward, exact in-step accept/reject,
+        then emit 1..k+1 tokens per slot. Rejected drafts roll back by
+        the per-slot length alone — their K/V entries sit past the new
+        length, masked off and overwritten next tick."""
+        spec = self.spec
+        last, lens, keys, temps, top_ks, top_ps = self._init_carry()
+        pre = (self.params, self.caches)
+        if self._has_draft_model():
+            pre += (self.draft_params, self.draft_caches)
+        pre += self._decode_extra_args()
+        tail = (last, lens, keys, temps, top_ks, top_ps,
+                self._spec_rows_arg())
+        if spec.drafter == "ngram":
+            tail += (self._commit(jnp.asarray(self._propose_ngram())),)
+        t_tick = time.monotonic()
+        try:
+            out = self._spec_step(*pre, *tail)
+        except Exception as e:  # noqa: BLE001 - shared recovery, then
+            # surface the error to the driver
+            self._fail_decode(active, e)
+            raise
+        if self._has_draft_model():
+            (toks, lps, accepts, caches, dcaches, keys, lens, last) = out
+            self.draft_caches = dcaches
+        else:
+            toks, lps, accepts, caches, keys, lens, last = out
+        self.caches = caches
+        self._carry = (last, lens, keys, temps, top_ks, top_ps)
+        toks = np.asarray(toks)
+        lps = np.asarray(lps)
+        accepts = np.asarray(accepts)
+        self.stats["ticks"] += 1
+        self._m_ticks.inc()
+        self._m_tick.observe(time.monotonic() - t_tick)
+        self._track_decode_recompiles()
+        if self.flight_recorder is not None:
+            self.flight_recorder.heartbeat(
+                f"spec tick {self.stats['ticks']} ({len(active)} active)")
+        emitted_total = 0
+        for i in active:
+            req = self.slots[i]
+            a = int(accepts[i])
+            # device-side truth: the fed token + a accepted drafts are
+            # now valid cache entries; toks[i, a] is next up
+            self.lengths[i] += a + 1
+            self.last_tok[i] = int(toks[i, a])
+            if self.spec_on[i]:
+                self.stats["spec_proposed"] += spec.k
+                self.stats["spec_accepted"] += a
+                self._m_spec_proposed.inc(spec.k)
+                self._m_spec_accepted.inc(a)
+                self._m_spec_len.observe(a)
+            for j in range(a + 1):
+                req.generated.append(int(toks[i, j]))
+                req.logprobs.append(float(lps[i, j]))
+                emitted_total += 1
+                if self._req_finished(req):
+                    # eod or max_new mid-speculation: later accepted
+                    # tokens are "after the end" — a non-speculative
+                    # run would never have produced them. The slot
+                    # retires below, which resets the (now past-end)
+                    # device mirrors with full carry hygiene.
+                    break
+            if self._req_finished(req):
+                self._retire(i)
+        self.stats["spec_emitted"] += emitted_total
+        self._m_tokens.inc(emitted_total)
+        self.last_progress_time = time.monotonic()
+        return len(active)
+
+    def _decode_tick(self) -> int:
+        """One batched decode for every decodable slot; returns how many
+        were served (0 = nothing to decode)."""
+        active = self._decode_rows()
+        if not active:
+            return 0
+        if self.spec is not None:
+            return self._decode_tick_spec(active)
+        last, lens, keys, temps, top_ks, top_ps = self._init_carry()
         t_tick = time.monotonic()
         try:
             toks, lps, caches, keys, lens = self._decode_step(
                 self.params, self.caches, *self._decode_extra_args(),
                 last, lens, keys, temps, top_ks, top_ps)
-        except Exception as e:  # noqa: BLE001 - fail the in-flight
-            # requests (their waiters must unblock) and restore a usable
-            # cache (donation may have consumed the old buffers), then
+        except Exception as e:  # noqa: BLE001 - shared recovery, then
             # surface the error to the driver
-            for i in active:
-                req = self.slots[i]
-                self._clear_slot(i)
-                req._finish(f"decode step failed: {e}")
-            self._m_active.set(self.num_active)
-            self._carry = None
-            self.caches = self._commit(self._fresh_caches())
+            self._fail_decode(active, e)
             raise
         self.caches = caches
         # toks/lens/keys chain into the next tick on device; only the
@@ -815,8 +1091,9 @@ class InferenceEngine:
         (warmup); any growth past that means a traced-vs-static leak crept
         in (e.g. a sampling knob going static) and every further tick is
         paying a compile."""
+        step = self._spec_step if self.spec is not None else self._decode_step
         try:
-            size = int(self._decode_step._cache_size())
+            size = int(step._cache_size())
         except Exception:  # noqa: BLE001 - private API; tracking degrades
             return
         if size > self._decode_cache_seen:
@@ -848,7 +1125,8 @@ class InferenceEngine:
                  max_new_tokens: int, temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 0.0,
                  eod: Optional[int] = None, seed: int = 0,
-                 deadline_s: Optional[float] = None
+                 deadline_s: Optional[float] = None,
+                 spec: bool = True
                  ) -> GenerationOutput:
         """Batch convenience with generate_tokens' semantics: submit one
         request per row, drain, and repack [B, maxp+max_new] (rows padded
@@ -883,7 +1161,8 @@ class InferenceEngine:
                     prompt=np.asarray(prompts[b, :p], np.int32),
                     max_new_tokens=maxp - p + max_new_tokens,
                     temperature=temperature, deadline_s=deadline_s,
-                    top_k=top_k, top_p=top_p, eod=eod, seed=seed + b)))
+                    top_k=top_k, top_p=top_p, eod=eod, seed=seed + b,
+                    spec=spec)))
         if self._thread is None:
             self.run_until_idle()
         for r in reqs:

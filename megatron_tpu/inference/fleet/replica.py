@@ -210,6 +210,11 @@ def _build_and_serve(spec: Dict[str, Any]) -> None:
         drain_timeout=float(spec.get("drain_timeout", 30.0)),
         stall_threshold_s=float(spec.get("stall_threshold_s", 10.0)),
         warmup=bool(spec.get("warmup", True)),
+        # speculative decoding ("ngram" | "model"; the fleet entry only
+        # wires the zero-weight ngram drafter — a draft checkpoint story
+        # belongs to tools/run_text_generation_server.py)
+        speculative=spec.get("speculative"),
+        spec_k=int(spec.get("spec_k", 4)),
         port_file=spec.get("port_file"),
         reload_dir=spec.get("reload_dir") or spec.get("load"),
         weights_version=weights_version,

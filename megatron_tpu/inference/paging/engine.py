@@ -61,7 +61,8 @@ class PagedInferenceEngine(InferenceEngine):
                  want_logprobs: bool = True, metrics=None,
                  flight_recorder=None,
                  force_donate: Optional[bool] = None,
-                 max_queue: Optional[int] = None):
+                 max_queue: Optional[int] = None,
+                 speculative=None):
         if page_size < 1:
             raise ValueError(f"page_size must be >= 1, got {page_size}")
         if num_pages is not None and num_pages < 2:
@@ -77,7 +78,7 @@ class PagedInferenceEngine(InferenceEngine):
             kv_cache_int8=kv_cache_int8, vocab_size=vocab_size, mesh=mesh,
             want_logprobs=want_logprobs, metrics=metrics,
             flight_recorder=flight_recorder, force_donate=force_donate,
-            max_queue=max_queue)
+            max_queue=max_queue, speculative=speculative)
         if self.num_pages - 1 < self.max_pages:
             raise ValueError(
                 f"num_pages={self.num_pages} cannot hold even one full "
@@ -97,6 +98,8 @@ class PagedInferenceEngine(InferenceEngine):
         self._table_dirty = True
         self.prefill_queue = ChunkedPrefillQueue(self.prefill_chunk)
         self._chunk_step = self._build_chunk_step()
+        self._draft_chunk_step = (self._build_draft_chunk_step()
+                                  if self._has_draft_model() else None)
         # admission order for the preemption policy (higher = younger)
         self._admit_seq = [0] * N
         self._admit_counter = 0
@@ -167,6 +170,22 @@ class PagedInferenceEngine(InferenceEngine):
                     jnp.zeros(sshape, jnp.float32),
                     jnp.zeros(sshape, jnp.float32))
         return (jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
+
+    def _fresh_draft_caches(self):
+        """Draft-model page pools (speculative decoding): the draft
+        config's own layer/head geometry over the SAME page count and
+        page size as the target pools, addressed through the SAME per-
+        slot page tables — one allocation/refcount/prefix-aliasing
+        story covers both trees (a page shared via the radix cache is
+        shared in both pools, since both were written through the same
+        table by the original prefill). Always bf16/f32."""
+        dcfg = self.spec.draft_cfg
+        shape = (dcfg.num_layers, self.num_pages, self.page_size,
+                 dcfg.n_kv_heads, dcfg.head_dim)
+        return (jnp.zeros(shape, dcfg.dtype), jnp.zeros(shape, dcfg.dtype))
+
+    def _spec_paged(self) -> bool:
+        return True
 
     # ----- jitted device steps --------------------------------------------
 
@@ -252,6 +271,29 @@ class PagedInferenceEngine(InferenceEngine):
             return tok, lp, plp, caches, key
 
         return chunk_step
+
+    def _build_draft_chunk_step(self):
+        """One prefill chunk of one prompt into the DRAFT page pools
+        (speculative model drafter): same table row and scratch-page
+        write fences as the target chunk, so shared-prefix aliasing and
+        padded-tail parking behave identically for both trees. Write-
+        only — the draft never scores prompt tokens."""
+        dcfg = self.spec.draft_cfg
+        from functools import partial
+
+        from megatron_tpu.models.language_model import lm_forward
+
+        @partial(jax.jit, donate_argnums=self._donate())
+        def draft_chunk(dparams, dcaches, table_row, tokens_c, off,
+                        write_start, write_end):
+            _, dcaches = lm_forward(dcfg, dparams, tokens_c,
+                                    kv_caches=dcaches, cache_index=off,
+                                    page_table=table_row,
+                                    page_write_start=write_start,
+                                    page_write_end=write_end)
+            return dcaches
+
+        return draft_chunk
 
     # ----- page accounting -------------------------------------------------
 
@@ -408,6 +450,15 @@ class PagedInferenceEngine(InferenceEngine):
                 jnp.int32(task.total - 1), jnp.asarray(task.key),
                 jnp.float32(req.temperature), jnp.int32(req.top_k),
                 jnp.float32(req.top_p))
+            self.caches = caches
+            if self._has_draft_model():
+                # mirror the chunk into the draft pools through the same
+                # table row and write fences
+                self.draft_caches = self._draft_chunk_step(
+                    self.draft_params, self.draft_caches,
+                    jnp.asarray(row[None, :]),
+                    jnp.asarray(toks_ext[:, :C]), jnp.int32(off),
+                    jnp.int32(task.write_start), jnp.int32(task.total))
         except Exception as e:  # noqa: BLE001 - a failing chunk must fail
             # THIS request, not strand it un-signalled and kill the loop
             # (same contract as the slot engine's prefill failure)
@@ -417,14 +468,14 @@ class PagedInferenceEngine(InferenceEngine):
             self._m_rejected.inc()
             if self._donate():
                 # the failed call may have consumed the donated pools
+                # (target AND draft trees)
                 for j, other in enumerate(self.slots):
                     if other is not None:
                         self._clear_slot(j)
                         other._finish(f"prefill failed: {e}")
-                self.caches = self._commit(self._fresh_caches())
+                self._rebuild_caches()
             self._m_active.set(self.num_active)
             return 1
-        self.caches = caches
         n = min(C, task.total - off)
         if self.want_logprobs:
             task.plp_parts.append(np.asarray(plp))
@@ -456,6 +507,9 @@ class PagedInferenceEngine(InferenceEngine):
         self.top_ks[i] = req.top_k
         self.top_ps[i] = req.top_p
         self.keys[i] = np.asarray(key)
+        if self.spec is not None:
+            self.spec_on[i] = bool(req.spec)
+            self._spec_rows_dev = None
         req.generated.append(int(tok))
         req.logprobs.append(float(lp))
         if not task.resumed and self.want_logprobs:
@@ -508,25 +562,36 @@ class PagedInferenceEngine(InferenceEngine):
         return True
 
     def _ensure_decode_pages(self) -> None:
-        """Before a decode tick, every decodable slot needs a real page
-        under its write position (lengths[i]); allocate across page
+        """Before a decode tick, every decodable slot needs real pages
+        under its write span (lengths[i] .. lengths[i] + span - 1; span
+        is 1 plain, k+1 speculative — rejected drafts roll back the
+        length but the pages stay mapped for future growth, and shared
+        prefix pages are never in the span). Allocate across page
         boundaries, preempting the youngest slot when the pool is dry.
         Each preemption frees that slot's pages, so this terminates."""
+        span = self._decode_write_span()
+        ps = self.page_size
         while True:
             rows = self._decode_rows()
+            dry = False
             for i in rows:
-                pg = int(self.lengths[i]) // self.page_size
-                if self.tables[i, pg] != SCRATCH_PAGE:
-                    continue
-                pages = self._alloc_pages(1)
-                if pages is None:
-                    if not self._preempt_one():
-                        # unreachable: slot i itself is preemptible
-                        return
-                    break  # re-derive rows (the victim may be in them)
-                self.tables[i, pg] = pages[0]
-                self._table_dirty = True
-            else:
+                first = int(self.lengths[i]) // ps
+                last_pg = (int(self.lengths[i]) + span - 1) // ps
+                for pg in range(first, last_pg + 1):
+                    if self.tables[i, pg] != SCRATCH_PAGE:
+                        continue
+                    pages = self._alloc_pages(1)
+                    if pages is None:
+                        if not self._preempt_one():
+                            # unreachable: slot i itself is preemptible
+                            return
+                        dry = True
+                        break  # re-derive rows (the victim may be gone)
+                    self.tables[i, pg] = pages[0]
+                    self._table_dirty = True
+                if dry:
+                    break
+            if not dry:
                 return
 
     # ----- stepping --------------------------------------------------------

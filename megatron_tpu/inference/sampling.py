@@ -55,6 +55,36 @@ def sample_logits(
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
+def filter_top_k_top_p(
+    scaled: jnp.ndarray,          # [B, V] temperature-scaled logits
+    top_k: jnp.ndarray,           # [B] int; 0 disables per row
+    top_p: jnp.ndarray,           # [B] float; 0 disables per row
+) -> jnp.ndarray:
+    """Per-row top-k then top-p composition on sorted logits — THE one
+    implementation of the filter semantics, shared by the batched
+    sampler below and the speculative verify's accept/reject
+    (inference/speculative.py, which flattens its [N, k+1, V] positions
+    into the batch axis): the speculative exactness contract is that
+    both paths draw from the IDENTICAL filtered distribution, so the
+    composition must never fork. Rows with top_k<=0 / top_p<=0 keep all
+    mass for that filter; each row's top token always survives. Masking
+    only values BELOW the kth keeps the descending sort valid for the
+    top-p pass, so one sort serves both filters."""
+    neg = jnp.finfo(jnp.float32).min
+    V = scaled.shape[-1]
+    desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    kth = jnp.take_along_axis(
+        desc, jnp.clip(top_k[:, None] - 1, 0, V - 1), axis=-1)
+    cond_tk = (top_k[:, None] > 0) & (scaled < kth)
+    scaled = jnp.where(cond_tk, neg, scaled)
+    desc = jnp.where((top_k[:, None] > 0) & (desc < kth), neg, desc)
+    cum = jnp.cumsum(jax.nn.softmax(desc, axis=-1), axis=-1)
+    cutoff_idx = jnp.sum(cum < top_p[:, None], axis=-1, keepdims=True)
+    cutoff = jnp.take_along_axis(desc, cutoff_idx, axis=-1)
+    return jnp.where((top_p[:, None] > 0) & (scaled < cutoff),
+                     neg, scaled)
+
+
 def sample_logits_batched(
     logits: jnp.ndarray,          # [B, V] float
     keys: jnp.ndarray,            # [B, 2] per-row PRNG keys
@@ -87,30 +117,13 @@ def sample_logits_batched(
     def _sample(logits):
         t = temperature[:, None]
         scaled = logits / jnp.where(t > 0, t, 1.0)
-
-        def _filter(scaled):
-            # top-k: kth-largest per row as threshold (rows with
-            # top_k<=0 keep all)
-            desc = jnp.sort(scaled, axis=-1)[:, ::-1]
-            kth = jnp.take_along_axis(
-                desc, jnp.clip(top_k[:, None] - 1, 0, V - 1), axis=-1)
-            cond_tk = (top_k[:, None] > 0) & (scaled < kth)
-            scaled = jnp.where(cond_tk, neg, scaled)
-            # top-p over the top-k-filtered logits (same composition
-            # order as the scalar sampler); always keeps each row's top
-            # token. Masking only values BELOW kth turns a descending
-            # sort into neg-padded descending, so no re-sort is needed.
-            desc = jnp.where((top_k[:, None] > 0) & (desc < kth), neg,
-                             desc)
-            cum = jnp.cumsum(jax.nn.softmax(desc, axis=-1), axis=-1)
-            cutoff_idx = jnp.sum(cum < top_p[:, None], axis=-1,
-                                 keepdims=True)
-            cutoff = jnp.take_along_axis(desc, cutoff_idx, axis=-1)
-            return jnp.where((top_p[:, None] > 0) & (scaled < cutoff),
-                             neg, scaled)
-
-        scaled = jax.lax.cond(jnp.any((top_k > 0) | (top_p > 0)),
-                              _filter, lambda s: s, scaled)
+        # filter semantics live in filter_top_k_top_p (shared with the
+        # speculative verify step); same composition order as the
+        # scalar sampler, one sort serves both filters
+        scaled = jax.lax.cond(
+            jnp.any((top_k > 0) | (top_p > 0)),
+            lambda s: filter_top_k_top_p(s, top_k, top_p),
+            lambda s: s, scaled)
         return jax.vmap(jax.random.categorical)(keys, scaled).astype(
             jnp.int32)
 

@@ -101,7 +101,10 @@ class GenerationService:
                  reload_dir: Optional[str] = None,
                  weights_version: Optional[int] = None,
                  stall_threshold_s: float = STALL_THRESHOLD_SECONDS,
-                 warmup: bool = False):
+                 warmup: bool = False,
+                 speculative: Optional[str] = None,
+                 spec_k: int = 4,
+                 draft_cfg=None, draft_params=None):
         """mesh + forward_fn serve sharded models: the mesh becomes
         ambient around generation (GSPMD handles tp/cp), forward_fn is the
         pp>1 pipelined forward (ref ForwardStep, forward_step.py:45-204).
@@ -122,7 +125,14 @@ class GenerationService:
         committed checkpoint), reported in responses + /admin/status.
         warmup=True defers readiness (/readyz stays 503) until warmup()
         has compiled the decode step — run_server drives it on a
-        background thread so probes get answered during the compile."""
+        background thread so probes get answered during the compile.
+        speculative: "ngram" or "model" turns on speculative decoding
+        in the engine (--serve_speculative; docs/serving.md): spec_k
+        drafts per slot verified by one multi-token target forward per
+        tick, greedy output token-identical to plain decode. "model"
+        needs draft_cfg + draft_params (a small draft network with its
+        own cache tree). Requests may opt out per call with
+        {"spec": false}."""
         if kv_cache_int8 and forward_fn is not None:
             # fail at construction, not as a 500 on every request — the
             # pipelined forward threads bf16 cache pairs (the same guard
@@ -164,7 +174,18 @@ class GenerationService:
         self._m_latency = self.metrics.histogram(
             "server_request_seconds", "API request wall time")
         self.engine = None
+        if speculative and not engine_slots:
+            raise ValueError(
+                "speculative decoding runs inside the continuous-batching "
+                "engine — serve with engine_slots > 0")
         if engine_slots:
+            spec_cfg = None
+            if speculative:
+                from megatron_tpu.inference.speculative import SpecConfig
+
+                spec_cfg = SpecConfig(k=spec_k, drafter=speculative,
+                                      draft_cfg=draft_cfg,
+                                      draft_params=draft_params)
             if kv_paging:
                 from megatron_tpu.inference.paging import PagedInferenceEngine
 
@@ -175,7 +196,8 @@ class GenerationService:
                     page_size=page_size, prefill_chunk=prefill_chunk,
                     num_pages=num_pages,
                     vocab_size=tokenizer.vocab_size, mesh=mesh,
-                    metrics=self.metrics, max_queue=engine_max_queue)
+                    metrics=self.metrics, max_queue=engine_max_queue,
+                    speculative=spec_cfg)
             else:
                 from megatron_tpu.inference.engine import InferenceEngine
 
@@ -184,7 +206,8 @@ class GenerationService:
                     max_seq_len=engine_max_seq_len,
                     kv_cache_int8=kv_cache_int8,
                     vocab_size=tokenizer.vocab_size, mesh=mesh,
-                    metrics=self.metrics, max_queue=engine_max_queue)
+                    metrics=self.metrics, max_queue=engine_max_queue,
+                    speculative=spec_cfg)
             self.engine.start()
         if not (warmup and self.engine is not None):
             # no deferred warmup: the first request pays the compile (the
@@ -378,6 +401,14 @@ class GenerationService:
         if self.request_timeout is not None:
             deadline_s = (self.request_timeout if deadline_s is None
                           else min(deadline_s, self.request_timeout))
+        # per-request speculative-decoding knob: passes through the
+        # fleet router untouched (the router proxies request bodies
+        # verbatim); a no-op unless the engine runs --serve_speculative.
+        # Greedy output is identical either way — the knob only trades
+        # per-token latency variance against throughput.
+        spec = req.get("spec", True)
+        if not isinstance(spec, bool):
+            raise ValueError("spec must be a JSON boolean")
 
         def generate():
             v0 = self.weights_version
@@ -393,7 +424,8 @@ class GenerationService:
                 forward_fn=self.forward_fn,
                 kv_cache_int8=self.kv_cache_int8,
                 engine=self.engine if use_engine else None,
-                deadline_s=deadline_s if use_engine else None)
+                deadline_s=deadline_s if use_engine else None,
+                spec=spec)
             out = {"text": texts, "segments": segments}
             if logprobs is not None:
                 out["logprobs"] = [list(map(float, row)) for row in logprobs]
@@ -561,7 +593,10 @@ def run_server(cfg: ModelConfig, params: Any, tokenizer,
                port_file: Optional[str] = None,
                reload_dir: Optional[str] = None,
                weights_version: Optional[int] = None,
-               stall_threshold_s: float = STALL_THRESHOLD_SECONDS) -> None:
+               stall_threshold_s: float = STALL_THRESHOLD_SECONDS,
+               speculative: Optional[str] = None,
+               spec_k: int = 4,
+               draft_cfg=None, draft_params=None) -> None:
     """Serve until killed. SIGTERM/SIGINT triggers a graceful drain
     (mirroring DistributedSignalHandler): stop admitting (503 +
     Retry-After), finish in-flight requests up to `drain_timeout`, then
@@ -582,7 +617,10 @@ def run_server(cfg: ModelConfig, params: Any, tokenizer,
                                 reload_dir=reload_dir,
                                 weights_version=weights_version,
                                 stall_threshold_s=stall_threshold_s,
-                                warmup=warmup)
+                                warmup=warmup,
+                                speculative=speculative, spec_k=spec_k,
+                                draft_cfg=draft_cfg,
+                                draft_params=draft_params)
     server = ThreadingHTTPServer((host, port), make_handler(service))
     bound_port = server.server_address[1]
     if port_file:
@@ -639,6 +677,8 @@ def run_server(cfg: ModelConfig, params: Any, tokenizer,
 
     mode = (f"continuous batching, {engine_slots} slots"
             + (", paged KV + prefix cache" if kv_paging else "")
+            + (f", speculative ({speculative}, k={spec_k})"
+               if speculative else "")
             if service.engine else "one-shot")
     print(f"serving generation API on http://{host}:{bound_port}/api "
           f"({mode})", flush=True)

@@ -121,12 +121,11 @@ def attention_block(
     # A vector cache_index is the continuous-batching slot cache
     # (inference/engine.py): every row decodes at its OWN depth, so each
     # row's new K/V scatters to its own position and attention masks each
-    # row to its own valid prefix (kv_lengths). Single-token only —
-    # admission prefill enters slots one at a time at a static index.
+    # row to its own valid prefix (kv_lengths). s == 1 is plain decode;
+    # s > 1 is the speculative verify pass (inference/speculative.py) —
+    # row b's queries land at positions cache_index[b]..cache_index[b]+s-1
+    # and each sees one position more than the last (kv_lengths + j).
     per_slot = getattr(cache_index, "ndim", 0) == 1
-    if per_slot and s != 1:
-        raise ValueError(
-            f"per-slot cache_index requires single-token decode (s={s})")
 
     paged = page_table is not None
     if paged:
@@ -139,16 +138,23 @@ def attention_block(
 
     def _paged_write(store, new):
         """Scatter new rows through the page table. Decode: new [B,1,...]
-        lands at each row's own depth. Chunk: new [1,C,...] lands at
-        positions cache_index..cache_index+C-1 of row 0."""
+        lands at each row's own depth; speculative verify: new [B,s,...]
+        lands at positions cache_index[b]..cache_index[b]+s-1 per row.
+        Chunk: new [1,C,...] lands at positions
+        cache_index..cache_index+C-1 of row 0."""
         ps = store.shape[1]
         if per_slot:
-            pos = cache_index                              # [B]
-            phys = jnp.take_along_axis(
-                page_table, (pos // ps)[:, None], axis=1,
-                mode="clip")[:, 0]
-            return store.at[phys, pos % ps].set(
-                new[:, 0].astype(store.dtype))
+            if s == 1:
+                pos = cache_index                          # [B]
+                phys = jnp.take_along_axis(
+                    page_table, (pos // ps)[:, None], axis=1,
+                    mode="clip")[:, 0]
+                return store.at[phys, pos % ps].set(
+                    new[:, 0].astype(store.dtype))
+            pos = cache_index[:, None] + jnp.arange(s)     # [B, s]
+            phys = jnp.take_along_axis(page_table, pos // ps, axis=1,
+                                       mode="clip")
+            return store.at[phys, pos % ps].set(new.astype(store.dtype))
         pos = cache_index + jnp.arange(s)                  # [C]
         phys = jnp.take(page_table[0], pos // ps, mode="clip")
         if page_write_start is not None:
@@ -199,12 +205,21 @@ def attention_block(
         kq, vq, ks, vs = kv_cache
         knew, ksnew = quantize_kv(k)
         vnew, vsnew = quantize_kv(v)
-        if per_slot:
+        if per_slot and s == 1:
             rows = jnp.arange(b)
             kq = kq.at[rows, cache_index].set(knew[:, 0])
             vq = vq.at[rows, cache_index].set(vnew[:, 0])
             ks = ks.at[rows, cache_index].set(ksnew[:, 0].astype(ks.dtype))
             vs = vs.at[rows, cache_index].set(vsnew[:, 0].astype(vs.dtype))
+            kv_lengths = cache_index + 1
+        elif per_slot:
+            # speculative verify: s tokens per row at each row's depth
+            rows = jnp.arange(b)[:, None]
+            pos = cache_index[:, None] + jnp.arange(s)     # [B, s]
+            kq = kq.at[rows, pos].set(knew)
+            vq = vq.at[rows, pos].set(vnew)
+            ks = ks.at[rows, pos].set(ksnew.astype(ks.dtype))
+            vs = vs.at[rows, pos].set(vsnew.astype(vs.dtype))
             kv_lengths = cache_index + 1
         else:
             at = (0, cache_index, 0, 0)
@@ -223,10 +238,19 @@ def attention_block(
         # functional KV cache: fixed-size [B, max_seq, nkv, D] buffers,
         # in-place slice update at cache_index (donated under jit).
         kc, vc = kv_cache
-        if per_slot:
+        if per_slot and s == 1:
             rows = jnp.arange(b)
             kc = kc.at[rows, cache_index].set(k[:, 0].astype(kc.dtype))
             vc = vc.at[rows, cache_index].set(v[:, 0].astype(vc.dtype))
+            kv_cache = (kc, vc)
+            k, v = kc, vc
+            kv_lengths = cache_index + 1
+        elif per_slot:
+            # speculative verify: s tokens per row at each row's depth
+            rows = jnp.arange(b)[:, None]
+            pos = cache_index[:, None] + jnp.arange(s)     # [B, s]
+            kc = kc.at[rows, pos].set(k.astype(kc.dtype))
+            vc = vc.at[rows, pos].set(v.astype(vc.dtype))
             kv_cache = (kc, vc)
             k, v = kc, vc
             kv_lengths = cache_index + 1

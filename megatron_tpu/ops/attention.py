@@ -71,13 +71,15 @@ def attention(
     q_offset: absolute position of q[0] (incremental decoding with KV cache).
 
     kv_lengths: per-row valid KV prefix (continuous-batching decode, where
-    every slot of the cache holds a sequence of a different age). Requires
-    q_len == 1 — the single query is the newest position (kv_lengths - 1),
-    so causality is subsumed by the prefix mask and the sliding window
-    becomes k_pos >= kv_lengths - window. On TPU under impl="pallas" this
-    runs the fused flash-decode kernel (ops/pallas/flash_decode.py) which
-    skips cache blocks past each row's prefix; elsewhere a masked einsum
-    computes the same values.
+    every slot of the cache holds a sequence of a different age). Query j
+    (j = 0..Sq-1) of a row is the position kv_lengths - 1 + j, so
+    causality is subsumed by the per-query prefix mask
+    (k_pos < kv_lengths + j) and the sliding window becomes
+    k_pos >= kv_lengths + j - window. Sq == 1 is plain decode; Sq > 1 is
+    the speculative multi-token verify. On TPU under impl="pallas" this
+    runs the fused flash-decode kernels (ops/pallas/flash_decode.py,
+    single- and multi-query variants) which skip cache blocks past each
+    row's prefix; elsewhere a masked einsum computes the same values.
 
     page_table: paged KV cache (inference/paging/): k/v are the shared
     page pools [num_pages, page_size, Hkv, D] and each row's logical
@@ -89,15 +91,26 @@ def attention(
     gather is exact — pages hold the same bits a dense cache would).
     """
     if page_table is not None:
-        if (kv_lengths is not None and q.shape[1] == 1
+        if (kv_lengths is not None
                 and impl == "pallas" and jax.default_backend() != "cpu"):
             try:
+                if q.shape[1] == 1:
+                    from megatron_tpu.ops.pallas.paged_flash_decode import (
+                        paged_flash_decode,
+                    )
+
+                    return paged_flash_decode(
+                        q, k, v, page_table, kv_lengths,
+                        sliding_window=sliding_window)
+                # multi-query decode (speculative verify: k+1 query rows
+                # per slot, each one position deeper than the last)
                 from megatron_tpu.ops.pallas.paged_flash_decode import (
-                    paged_flash_decode,
+                    paged_flash_decode_mq,
                 )
 
-                return paged_flash_decode(q, k, v, page_table, kv_lengths,
-                                          sliding_window=sliding_window)
+                return paged_flash_decode_mq(
+                    q, k, v, page_table, kv_lengths,
+                    sliding_window=sliding_window)
             except (ImportError, ValueError) as e:
                 warnings.warn(
                     f"paged flash-decode kernel unavailable ({e}); falling "
@@ -110,19 +123,28 @@ def attention(
         k = k[page_table].reshape(bq, -1, *k.shape[-2:])
         v = v[page_table].reshape(bq, -1, *v.shape[-2:])
     if kv_lengths is not None:
-        if q.shape[1] != 1:
-            raise ValueError(
-                f"kv_lengths requires single-token decode (q_len="
-                f"{q.shape[1]}); batched prefill uses causal masking")
+        # q_len == 1 is plain continuous-batching decode; q_len > 1 is
+        # the speculative verify pass — query j of a row sits at
+        # absolute position kv_lengths - 1 + j and sees the prefix plus
+        # the drafts written before it (k_pos < kv_lengths + j)
         if dropout > 0.0 or padding_mask is not None:
             raise ValueError("kv_lengths is a serving-decode path: no "
                              "dropout / padding masks")
         if impl == "pallas" and jax.default_backend() != "cpu":
             try:
-                from megatron_tpu.ops.pallas.flash_decode import flash_decode
+                if q.shape[1] == 1:
+                    from megatron_tpu.ops.pallas.flash_decode import (
+                        flash_decode,
+                    )
 
-                return flash_decode(q, k, v, kv_lengths,
-                                    sliding_window=sliding_window)
+                    return flash_decode(q, k, v, kv_lengths,
+                                        sliding_window=sliding_window)
+                from megatron_tpu.ops.pallas.flash_decode import (
+                    flash_decode_mq,
+                )
+
+                return flash_decode_mq(q, k, v, kv_lengths,
+                                       sliding_window=sliding_window)
             except (ImportError, ValueError) as e:
                 warnings.warn(
                     f"flash-decode kernel unavailable ({e}); falling back "
@@ -227,14 +249,18 @@ def attention(
     scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, kf)  # [B, Hkv, G, Sq, Skv]
 
     if kv_lengths is not None:
-        # per-row valid prefix (slot cache): the query is the newest
-        # position, so prefix + window masking replaces the causal bias
-        k_pos = jnp.arange(skv)[None, :]
-        allowed = k_pos < kv_lengths[:, None]
+        # per-row valid prefix (slot cache): query j of row b sits at
+        # absolute position kv_lengths[b] - 1 + j, so it sees
+        # k_pos < kv_lengths[b] + j (j = 0 is the plain single-token
+        # decode mask; j > 0 covers the speculative multi-token verify,
+        # where each later query also sees the drafts before it)
+        k_pos = jnp.arange(skv)[None, None, :]
+        qi = jnp.arange(sq)[None, :, None]
+        allowed = k_pos < kv_lengths[:, None, None] + qi
         if sliding_window is not None:
-            allowed &= k_pos >= kv_lengths[:, None] - sliding_window
+            allowed &= k_pos >= kv_lengths[:, None, None] + qi - sliding_window
         neg = jnp.asarray(jnp.finfo(scores.dtype).min, scores.dtype)
-        scores = jnp.where(allowed[:, None, None, None, :], scores, neg)
+        scores = jnp.where(allowed[:, None, None, :, :], scores, neg)
     else:
         bias = _mask_bias(sq, skv, mask_type, sliding_window, q_offset,
                           scores.dtype)

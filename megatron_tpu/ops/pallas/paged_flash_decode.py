@@ -88,6 +88,123 @@ def _paged_decode_kernel(lens_ref, pt_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
 
 
+def _paged_mq_decode_kernel(lens_ref, pt_ref, q_ref, k_ref, v_ref, o_ref,
+                            m_scr, l_scr, acc_scr,
+                            *, scale: float, window: Optional[int],
+                            page_size: int, groups: int, sq: int):
+    """Multi-query variant: the q tile is the Sq speculative query rows
+    x G grouped heads of one kv head, flattened to [Sq*G, D]; query j
+    sees k_pos < kv_lengths + j (each verify query one position deeper).
+    Page resolution is identical to the single-query kernel — queries
+    never index pages, only the kv blocks do."""
+    b = pl.program_id(0)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+    kv_len = lens_ref[b]
+    R = sq * groups
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # the deepest query (sq - 1) sees up to kv_len + sq - 2
+    @pl.when(ki * page_size < kv_len + sq - 1)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale      # [R, D]
+        k = k_ref[0, 0].astype(jnp.float32)              # [ps, D]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [R, ps]
+
+        k_pos = ki * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (R, page_size), 1)
+        q_idx = jax.lax.broadcasted_iota(jnp.int32, (R, page_size), 0) // groups
+        allowed = k_pos < kv_len + q_idx
+        if window is not None:
+            allowed &= k_pos >= kv_len + q_idx - window
+        s = jnp.where(allowed, s, _NEG_INF)
+
+        m_prev = m_scr[:]                                # [R, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.where(allowed, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        v = v_ref[0, 0].astype(jnp.float32)              # [ps, D]
+        pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())))
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        m_scr[:] = m_new
+
+    @pl.when(ki == nk - 1)
+    def _emit():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+def paged_flash_decode_mq(
+    q: jnp.ndarray,            # [B, Sq, Hq, D] (Sq = spec k+1 query rows)
+    k_pages: jnp.ndarray,      # [P, ps, Hkv, D] shared page pool
+    v_pages: jnp.ndarray,      # [P, ps, Hkv, D]
+    page_table: jnp.ndarray,   # [B, max_pages] int32
+    kv_lengths: jnp.ndarray,   # [B] int32, FIRST query's visible prefix
+    sliding_window: Optional[int] = None,
+) -> jnp.ndarray:
+    """Multi-query decode attention over paged KV (the speculative
+    verify pass: query j sees k_pos < kv_lengths + j). Returns
+    [B, Sq, Hq, D]; ValueError for unsupported shapes (the attention()
+    dispatcher falls back to the gather + masked einsum)."""
+    b, sq, hq, d = q.shape
+    _, ps, hkv, _ = k_pages.shape
+    if hq % hkv:
+        raise ValueError(f"query heads {hq} not a multiple of kv heads {hkv}")
+    if ps % 8:
+        raise ValueError(f"page_size {ps} must be a multiple of 8")
+    if page_table.shape[0] != b:
+        raise ValueError(
+            f"page_table rows {page_table.shape[0]} != batch {b}")
+    groups = hq // hkv
+    R = sq * groups
+    max_pages = page_table.shape[1]
+
+    qt = q.reshape(b, sq, hkv, groups, d).transpose(0, 2, 1, 3, 4)
+    qt = qt.reshape(b, hkv, R, d)                        # [B, Hkv, R, D]
+    kt = jnp.transpose(k_pages, (0, 2, 1, 3))            # [P, Hkv, ps, D]
+    vt = jnp.transpose(v_pages, (0, 2, 1, 3))
+    lens = jnp.asarray(kv_lengths, jnp.int32)
+    table = jnp.asarray(page_table, jnp.int32)
+
+    kernel = functools.partial(
+        _paged_mq_decode_kernel, scale=float(1.0 / (d ** 0.5)),
+        window=sliding_window, page_size=ps, groups=groups, sq=sq)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, hkv, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, 1, R, d),
+                         lambda bi, h, ki, lens, pt: (bi, h, 0, 0)),
+            pl.BlockSpec((1, 1, ps, d),
+                         lambda bi, h, ki, lens, pt: (pt[bi, ki], h, 0, 0)),
+            pl.BlockSpec((1, 1, ps, d),
+                         lambda bi, h, ki, lens, pt: (pt[bi, ki], h, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, R, d),
+                               lambda bi, h, ki, lens, pt: (bi, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((R, 1), jnp.float32),
+            pltpu.VMEM((R, 1), jnp.float32),
+            pltpu.VMEM((R, d), jnp.float32),
+        ],
+    )
+    o = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, R, d), q.dtype),
+        interpret=_interpret(),
+    )(lens, table, qt, kt, vt)
+    return o.reshape(b, hkv, sq, groups, d).transpose(0, 2, 1, 3, 4
+                                                      ).reshape(b, sq, hq, d)
+
+
 def paged_flash_decode(
     q: jnp.ndarray,            # [B, 1, Hq, D]
     k_pages: jnp.ndarray,      # [P, ps, Hkv, D] shared page pool

@@ -357,6 +357,37 @@ def test_paged_decode_step_audit_clean():
     assert len(don.donated) == 2, don.donated  # the k/v page pools
 
 
+@pytest.mark.parametrize("builder", ["spec_decode_step_target",
+                                     "spec_paged_decode_step_target"])
+def test_spec_decode_step_audit_clean(builder):
+    """Speculative decode step (slot AND paged, model drafter): zero
+    collectives, ZERO host callbacks — the draft-proposal scan and the
+    exact accept/reject (uniform draws, residual categoricals) must all
+    stay on device — and FULL donation of BOTH cache trees (2 target
+    k/v stacks + 2 draft k/v stacks). bf16->f32 promotions are bounded
+    to the known small intermediates: the per-layer softmax_fp32 K
+    upcasts of target and draft (the draft's multiplied through its
+    k-step proposal scan), the [N, k+1] verify attention slices, and
+    the [N, (k+1,) V] logits rows the accept math scores — anything
+    cache-sized is a new silent upcast and fails."""
+    t = getattr(targets, builder)()
+    rep = jaxpr_audit.audit_jaxpr(t.jaxpr(), t.name)
+    assert rep.collectives == []
+    assert rep.callbacks == []
+    # every tolerated promotion is tiny (K-upcast slices, verify rows,
+    # logits rows); the full caches/pools would be >= 4*32*2*8 * layers
+    import math
+
+    too_big = [p for p in rep.promotions
+               if math.prod(p.shape) > 4 * 32 * 2 * 8]
+    assert too_big == [], too_big
+    assert len(rep.promotions) <= 12, rep.promotions
+
+    don = jaxpr_audit.audit_donation(t.lowered())
+    # target k/v stacks + draft k/v stacks
+    assert len(don.donated) == 4, don.donated
+
+
 # ---------------------------------------------------------------------------
 # golden comm contracts
 # ---------------------------------------------------------------------------

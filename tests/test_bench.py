@@ -40,18 +40,38 @@ def test_bench_main_emits_one_json_line(monkeypatch):
                             params_dtype="float32")
 
     monkeypatch.setattr(bench, "headline_config", tiny_headline)
-    # keep runtime sane on CPU: two candidates, 1 timed iter
+    # keep runtime sane on CPU: two candidates, 1 timed iter, and a
+    # shrunk speculative leg (2 slots, 16 tokens, 1 drain — the full
+    # default geometry runs in the slow speedup-gate test below)
     monkeypatch.setattr(bench, "CANDIDATES", (
         dict(micro_bs=2, granularity="selective", ce_chunk=0),
         dict(micro_bs=2, granularity="selective", ce_chunk=16),
     ))
+    import functools
+
+    monkeypatch.setattr(
+        bench, "serve_speculative_bench",
+        functools.partial(bench.serve_speculative_bench, num_slots=2,
+                          new_tokens=16, reps=1))
+    monkeypatch.setattr(
+        bench, "serving_engine_bench",
+        functools.partial(bench.serving_engine_bench, num_slots=2,
+                          new_tokens=12))
+    monkeypatch.setattr(
+        bench, "serve_prefix_cache_bench",
+        functools.partial(bench.serve_prefix_cache_bench, num_requests=4,
+                          new_tokens=2))
+    monkeypatch.setattr(
+        bench, "serve_slo_bench",
+        functools.partial(bench.serve_slo_bench, num_requests=8,
+                          new_tokens=4))
     buf = io.StringIO()
     with redirect_stdout(buf):
         bench.main()
     lines = [l for l in buf.getvalue().splitlines() if l.strip()]
     # full (non-quick) runs: the serving metric lines, then the headline
     # LAST (the only positional contract the driver relies on)
-    assert len(lines) == 4
+    assert len(lines) == 5
     serve = json.loads(lines[0])
     assert serve["metric"] == "serve_decode_throughput_toks_per_s"
     assert set(serve) >= {"metric", "value", "unit", "vs_baseline"}
@@ -64,7 +84,17 @@ def test_bench_main_emits_one_json_line(monkeypatch):
     # shared-system-prompt traffic via the radix prefix cache
     assert prefix["value"] >= 1.5, prefix
     assert prefix["detail"]["decode_recompiles_after_warmup"] == 0
-    slo = json.loads(lines[2])
+    spec = json.loads(lines[2])
+    assert spec["metric"] == "serve_speculative_speedup"
+    assert "error" not in spec, spec
+    # tier-1 gates only the DETERMINISTIC facts (accept rate off the
+    # engine counters, zero recompiles, greedy parity is asserted
+    # inside the bench itself); the >= 2x wall-clock gate is the slow
+    # test below — a timing ratio in tier-1 flakes under suite load
+    assert spec["detail"]["accept_rate"] >= 0.9, spec
+    assert spec["detail"]["decode_recompiles_after_warmup"] == 0
+    assert spec["vs_baseline"] > 0, spec
+    slo = json.loads(lines[3])
     assert slo["metric"] == "serve_slo_offered_load"
     assert "error" not in slo, slo
     # every request must complete (a lost request zeroes the line) and
@@ -148,6 +178,16 @@ def test_bench_probe_retries_until_backend_up(monkeypatch):
                             params_dtype="float32"))
     monkeypatch.setattr(bench, "CANDIDATES", (
         dict(micro_bs=2, granularity="selective", ce_chunk=0),))
+    import functools
+
+    # this test is about probe retry semantics — stub the serving legs
+    # that ride along in a full main() entirely (their real coverage is
+    # test_bench_main_emits_one_json_line + the slow speedup gate)
+    for leg in ("serving_engine_bench", "serve_prefix_cache_bench",
+                "serve_speculative_bench", "serve_slo_bench"):
+        monkeypatch.setattr(
+            bench, leg,
+            lambda deadline, _leg=leg, **kw: {"metric": _leg, "value": 0.0})
     buf = io.StringIO()
     with redirect_stdout(buf):
         bench.main()
@@ -239,6 +279,26 @@ def test_bench_extras_ride_in_detail(monkeypatch):
     fp8 = out["detail"]["serving_fp8_7b"]
     assert fp8["decode_tokens_per_sec"] > 0
     assert fp8["weights"].startswith("fp8")
+
+
+@pytest.mark.slow  # ~35s: two recipe-geometry engines, median-of-3
+# drains each way; the acceptance gate for the >= 2x speculative
+# speedup claim (timed, so it must run solo — the tier-1 smoke above
+# gates only the deterministic accept-rate/recompile facts)
+def test_serve_speculative_bench_speedup_gate(monkeypatch):
+    import time
+
+    import bench
+
+    monkeypatch.setenv("MEGATRON_TPU_JAX_CACHE", "")
+    line = bench.serve_speculative_bench(time.perf_counter() + 280)
+    assert "error" not in line, line
+    assert line["detail"]["accept_rate"] >= 0.95, line
+    assert line["detail"]["decode_recompiles_after_warmup"] == 0
+    # >= 2x tokens/s vs the same engine without speculation on the
+    # high-acceptance CPU micro-bench (ISSUE 9 acceptance criterion;
+    # measured 2.3-3.0x across quiet runs)
+    assert line["vs_baseline"] >= 2.0, line
 
 
 def test_bench_quick_mode(monkeypatch):

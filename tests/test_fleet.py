@@ -140,9 +140,17 @@ def test_telemetry_report_serving_section():
         + [{"kind": "replica_breaker_open", "replica": "u"},
            {"kind": "replica_readmitted", "replica": "u"},
            {"kind": "serve_drain_begin", "timeout_s": 5},
-           {"kind": "weight_reload", "version": 2}])
+           {"kind": "weight_reload", "version": 2}]
+        # cumulative speculative snapshots: the LAST one is the totals
+        + [{"kind": "serve_spec", "proposed": 10, "accepted": 2,
+            "emitted": 6, "ticks": 4, "k": 4, "drafter": "ngram"},
+           {"kind": "serve_spec", "proposed": 40, "accepted": 30,
+            "emitted": 50, "ticks": 10, "k": 4, "drafter": "ngram"}])
     summary = telemetry_report.summarize(events)
     sv = summary["serving"]
+    assert sv["speculative"]["accept_rate"] == 0.75
+    assert sv["speculative"]["tokens_per_forward"] == 5.0
+    assert sv["speculative"]["drafter"] == "ngram"
     assert sv["requests"]["total"] == 10
     assert sv["requests"]["by_status"] == {"ok": 9, "timeout": 1}
     assert sv["ttft_s"]["p50"] == 0.05
@@ -152,6 +160,7 @@ def test_telemetry_report_serving_section():
                            "weight_reloads": 1}
     text = telemetry_report.render(summary)
     assert "failovers" in text and "tpot" in text
+    assert "accept rate 0.75" in text and "tokens/forward" in text
 
 
 # ---------------------------------------------------------------------------
@@ -337,7 +346,12 @@ def test_rolling_update_survives_unreachable_replica():
     try:
         router = ReplicaRouter([_dead_url(), live.url], retry_backoff_s=0.0,
                                metrics=MetricsRegistry())
-        results = router.rolling_update(load="ckpts", drain_timeout=1.0)
+        # ready_timeout=1.0: the always-readmit cleanup polls the DEAD
+        # replica's /readyz for the full ready_timeout — the default 60s
+        # is pure tier-1 wall time here (the semantics under test are
+        # "cleanup ran and the fleet keeps serving", not the wait)
+        results = router.rolling_update(load="ckpts", drain_timeout=1.0,
+                                        ready_timeout=1.0)
         # stops at the first failing replica; cleanup still ran, so the
         # dead replica is NOT stuck excluded from dispatch forever
         assert len(results) == 1 and "error" in results[0]
@@ -609,6 +623,51 @@ def test_hot_reload_over_http(fleet_service, tmp_path):
                        {"load": str(tmp_path / "missing")})
     assert code == 409
     assert _get(url, "/admin/status")[1]["weights_version"] == 3
+
+
+@pytest.mark.slow  # 6s measured cacheless (one speculating engine
+# compile behind a live router); the engine-level knob parity stays
+# tier-1 in test_speculative.py and the server-side parse is pure code
+def test_spec_knob_passes_through_router_and_replica():
+    """Per-request speculative knob (the 'spec' JSON field) flows
+    router -> replica -> engine untouched: a speculating in-process
+    service behind a real RouterServer answers {"spec": false} and
+    {"spec": true} with the SAME greedy text as a plain service (greedy
+    purity is unchanged by speculation), and the engine's proposal
+    counter moves only for the spec=true request."""
+    from megatron_tpu.inference.fleet.router import RouterServer
+
+    tok = NullTokenizer(CFG.vocab_size - 1)
+    svc = GenerationService(CFG, PARAMS, tok, engine_slots=2,
+                            engine_max_seq_len=64,
+                            metrics=MetricsRegistry(),
+                            speculative="ngram", spec_k=3)
+    server = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(svc))
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{server.server_address[1]}"
+    router = RouterServer([url], probe_interval=0.2,
+                          metrics=MetricsRegistry()).start()
+    try:
+        req = {"prompts": ["3 7 11"], "tokens_to_generate": 6,
+               "temperature": 0.0}
+        # spec=False through the router reaches the engine (zero
+        # proposals counted); spec-off == plain decode is pinned at the
+        # engine level by test_speculative.py, so it serves as the
+        # greedy reference here
+        code, body = _post(router.url, "/api", {**req, "spec": False})
+        assert code == 200
+        want = body["text"]
+        assert svc.engine.stats["spec_proposed"] == 0
+        code, body = _post(router.url, "/api", {**req, "spec": True})
+        assert code == 200 and body["text"] == want
+        assert svc.engine.stats["spec_proposed"] > 0
+        # malformed knob is a client error, not a 500
+        assert _post(router.url, "/api", {**req, "spec": "yes"})[0] == 400
+    finally:
+        router.close()
+        server.shutdown()
+        server.server_close()
+        svc.shutdown()
 
 
 # ---------------------------------------------------------------------------
@@ -926,6 +985,64 @@ def test_chaos_failover_paged_engine(tmp_path):
         while r0.poll() is None and time.monotonic() < deadline:
             time.sleep(0.1)
         assert r0.poll() == -9
+    finally:
+        if router is not None:
+            router.close()
+        r0.close()
+        r1.close()
+
+
+@pytest.mark.slow  # ~90s: two subprocess warmups of SPECULATING
+# replicas + slowed-tick traffic; the in-process spec-knob passthrough
+# test keeps the router/replica plumbing in tier-1
+def test_chaos_failover_speculating_replica(tmp_path):
+    """SIGKILL a replica running speculative decoding mid-stream: the
+    router's retry completes every request token-identically (greedy
+    purity is unchanged by speculation — a retried request re-derives
+    the same accept/reject outcome on the survivor)."""
+    # kill at tick 12: warmup costs ~2 ticks and a speculating engine
+    # can emit SEVERAL tokens per tick, so the kill must land early
+    # enough that r0 still has requests in flight
+    spec_kw = dict(speculative="ngram", spec_k=3)
+    r0 = _spawn(tmp_path, "r0", fault="kill_replica:12,slow_tick:30",
+                **spec_kw)
+    r1 = _spawn(tmp_path, "r1", fault="slow_tick:30", **spec_kw)
+    router = None
+    try:
+        r0.wait_ready(timeout=300)
+        r1.wait_ready(timeout=300)
+        prompts = [f"{3 + i} {4 + i} {5 + i}" for i in range(8)]
+        refs = {}
+        for p in prompts:
+            code, body = _post(r1.url, "/api",
+                               {"prompts": [p], "tokens_to_generate": 12,
+                                "temperature": 0.0})
+            assert code == 200
+            refs[p] = body["text"]
+        router = ReplicaRouter([r0.url, r1.url], probe_interval=0.2,
+                               request_timeout=60.0,
+                               metrics=MetricsRegistry()).start()
+        results = {}
+
+        def client(p):
+            body = json.dumps({"prompts": [p], "tokens_to_generate": 12,
+                               "temperature": 0.0}).encode()
+            results[p] = router.dispatch(body)
+
+        threads = [threading.Thread(target=client, args=(p,))
+                   for p in prompts]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=300)
+        for p in prompts:
+            status, _, rbody = results[p]
+            assert status == 200, (p, status, rbody)
+            assert json.loads(rbody)["text"] == refs[p]
+        deadline = time.monotonic() + 10
+        while r0.poll() is None and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert r0.poll() == -9, f"r0 rc={r0.poll()}"
     finally:
         if router is not None:
             router.close()

@@ -54,6 +54,37 @@ def extra_args(parser):
                         "engine's capacity). Smaller oversubscribes: the "
                         "engine evicts cached prefixes and preempts the "
                         "youngest request under pressure")
+    g.add_argument("--serve_speculative", choices=("ngram", "model"),
+                   default=None,
+                   help="speculative decoding in the engine "
+                        "(docs/serving.md): per-slot draft proposal + one "
+                        "batched multi-token verify forward per tick, "
+                        "exact accept/reject — greedy output is token-"
+                        "identical to plain decode, throughput scales "
+                        "with the acceptance rate. 'ngram' is the zero-"
+                        "weight prompt-lookup drafter; 'model' runs a "
+                        "small draft model (see --serve_draft_*)")
+    g.add_argument("--serve_spec_k", type=int, default=4,
+                   help="drafted tokens per slot per tick (the verify "
+                        "forward takes k+1 query rows; the engine "
+                        "reserves k positions of sequence headroom)")
+    g.add_argument("--serve_draft_layers", type=int, default=None,
+                   help="draft model depth (--serve_speculative model): "
+                        "the draft is the target architecture truncated "
+                        "to this many layers (default: same depth — only "
+                        "useful for testing). Loading a DEEPER checkpoint "
+                        "into the truncated tree restores its FIRST N "
+                        "layers (the stacked-layer leading dim slices); a "
+                        "properly distilled draft checkpoint is still the "
+                        "real producer (ROADMAP item 3). The draft keeps "
+                        "its own KV cache tree threaded through the same "
+                        "slot/page machinery")
+    g.add_argument("--serve_draft_checkpoint", default=None,
+                   help="committed checkpoint dir for the draft model's "
+                        "weights (manifest-verified like /admin/reload; "
+                        "the tree must match the draft config). Without "
+                        "it the draft serves randomly initialized "
+                        "weights — acceptance will be near zero")
     g.add_argument("--serve_max_queue", type=int, default=None,
                    help="bound the engine admission queue: requests "
                         "beyond this many waiters get HTTP 503 + "
@@ -169,6 +200,36 @@ def main(argv=None):
     engine_max_seq_len = args.serve_max_seq_len
     if engine_slots and engine_max_seq_len is None:
         engine_max_seq_len = min(cfg.model.seq_length, 2048)
+
+    # speculative decoding: build the draft model (model drafter) and
+    # load its verified weights (PR 7's loader — torn/bitrotted saves
+    # never reach a serving replica)
+    draft_cfg = draft_params = None
+    if args.serve_speculative == "model":
+        import dataclasses
+
+        draft_cfg = cfg.model
+        if args.serve_draft_layers:
+            draft_cfg = dataclasses.replace(
+                cfg.model, num_layers=args.serve_draft_layers).validate()
+        draft_params = init_params(draft_cfg,
+                                   jax.random.PRNGKey(cfg.training.seed + 1))
+        if args.serve_draft_checkpoint:
+            from megatron_tpu.inference.fleet.reload import (
+                load_verified_params,
+            )
+
+            draft_params, dit = load_verified_params(
+                args.serve_draft_checkpoint, draft_params)
+            print(f"loaded draft checkpoint at iteration {dit}")
+        else:
+            print("WARNING: draft model serving randomly initialized "
+                  "weights (no --serve_draft_checkpoint) — expect near-"
+                  "zero acceptance")
+    if args.serve_speculative and sharded:
+        raise SystemExit(
+            "--serve_speculative is single-chip serving only in v1 "
+            "(the spec step is not threaded through the sharded forward)")
     if engine_slots:
         m = cfg.model
         bpe = 1 if args.kv_cache_int8 else 2
@@ -201,7 +262,10 @@ def main(argv=None):
                drain_timeout=args.serve_drain_timeout,
                warmup=args.serve_warmup,
                reload_dir=cfg.training.load or None,
-               weights_version=weights_version)
+               weights_version=weights_version,
+               speculative=args.serve_speculative,
+               spec_k=args.serve_spec_k,
+               draft_cfg=draft_cfg, draft_params=draft_params)
 
 
 if __name__ == "__main__":

@@ -158,7 +158,24 @@ def _summarize_serving(events: List[Dict[str, Any]]
     counters (breaker opens, readmits, drains, weight reloads)."""
     reqs = [e for e in events if e.get("kind") == "serve_request"]
     routes = [e for e in events if e.get("kind") == "serve_route"]
+    specs = [e for e in events if e.get("kind") == "serve_spec"]
     out: Dict[str, Any] = {}
+    if specs:
+        # serve_spec records are cumulative per engine process (emitted
+        # on each retire); the LAST one is the totals. accept_rate is
+        # accepted/proposed drafts; tokens_per_forward is emitted
+        # tokens over decode ticks — the effective speedup numerator
+        # (1.0 = plain decode, k+1 = every draft accepted).
+        s = specs[-1]
+        out["speculative"] = {
+            "drafter": s.get("drafter"), "k": s.get("k"),
+            "proposed": int(s.get("proposed", 0)),
+            "accepted": int(s.get("accepted", 0)),
+            "accept_rate": round(
+                s.get("accepted", 0) / max(s.get("proposed", 0), 1), 4),
+            "tokens_per_forward": round(
+                s.get("emitted", 0) / max(s.get("ticks", 0), 1), 3),
+        }
     if reqs:
         by_status: Dict[str, int] = {}
         for e in reqs:
@@ -249,6 +266,12 @@ def render(summary: Dict[str, Any]) -> str:
                 p = sv[key]
                 lines.append(f"  {label}: p50 {p['p50']} | "
                              f"p95 {p['p95']} | p99 {p['p99']}")
+        if "speculative" in sv:
+            s = sv["speculative"]
+            lines.append(
+                f"  speculative ({s['drafter']}, k={s['k']}): "
+                f"accept rate {s['accept_rate']} | "
+                f"{s['tokens_per_forward']} tokens/forward")
         if "router" in sv:
             r = sv["router"]
             lines.append(f"  router: {r['routed']} routed | "
