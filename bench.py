@@ -876,6 +876,97 @@ def async_loop_bench(deadline, stall_ms=20.0, iters=14, skip_gaps=2):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def preempt_save_bench(deadline, preempt_iter=4, train_iters=64):
+    """SIGTERM -> committed-checkpoint wall time (CPU-able, pre-headline):
+    a tiny TrainLoop is preempted at an exact step via the `preempt_at`
+    fault (which self-delivers a real SIGTERM), takes the expedited
+    synchronous-save path, and the journal's `preemption` event reports
+    notice->commit latency — the preemption notice budget, tracked across
+    PRs so checkpoint growth or save-path regressions show up as a number
+    rather than as lost work on the next real preemption."""
+    import shutil
+    import tempfile
+
+    from megatron_tpu.config import (
+        ModelConfig, OptimizerConfig, RunConfig, TrainingConfig,
+    )
+    from megatron_tpu.telemetry.journal import read_events
+    from megatron_tpu.training import checkpointing, resilience
+    from megatron_tpu.training.pretrain import TrainLoop
+
+    line = {"metric": "preempt_save_latency_ms", "value": 0.0,
+            "unit": "ms_sigterm_to_committed_checkpoint",
+            "vs_baseline": 0.0, "detail": {}}
+    if deadline - time.perf_counter() < 45:
+        line["error"] = "budget_exhausted"
+        return line
+    import jax
+
+    n_dev = jax.device_count()
+    gbs = n_dev
+    h, seq, vocab = (256, 128, 512) if n_dev == 1 else (128, 64, 256)
+    model = ModelConfig(
+        num_layers=2, hidden_size=h, num_attention_heads=4, num_kv_heads=4,
+        ffn_hidden_size=2 * h, vocab_size=vocab, seq_length=seq,
+        params_dtype="float32").validate()
+    rng = np.random.default_rng(0)
+    proto = {
+        "tokens": rng.integers(0, vocab, (gbs, seq)).astype(np.int64),
+        "labels": rng.integers(0, vocab, (gbs, seq)).astype(np.int64),
+        "loss_mask": np.ones((gbs, seq), np.float32),
+    }
+
+    def factory(consumed, gbs_):
+        def gen():
+            while True:
+                yield proto
+        return gen()
+
+    tmp = tempfile.mkdtemp(prefix="mtpu_preempt_bench_")
+    prev_fault = os.environ.get(resilience.FAULT_ENV)
+    try:
+        os.environ[resilience.FAULT_ENV] = f"preempt_at:{preempt_iter}"
+        tele = os.path.join(tmp, "tele")
+        save = os.path.join(tmp, "ckpt")
+        cfg = RunConfig(
+            model=model,
+            optimizer=OptimizerConfig(lr=1e-3, lr_decay_style="constant"),
+            training=TrainingConfig(
+                micro_batch_size=1, global_batch_size=gbs,
+                train_iters=train_iters, log_interval=1 << 30,
+                seed=0, save=save, telemetry_dir=tele,
+                preempt_save_timeout=120.0))
+        loop = TrainLoop(cfg, log=lambda m: None)
+        loop.train(factory)
+        evs, _ = read_events(os.path.join(tele, "events.jsonl"))
+        pre = [e for e in evs if e["kind"] == "preemption"]
+        if not pre:
+            line["error"] = "no preemption event journaled"
+            return line
+        if checkpointing.read_tracker(save) != preempt_iter:
+            line["error"] = (f"tracker {checkpointing.read_tracker(save)} "
+                             f"!= preempt iteration {preempt_iter}")
+            return line
+        line["value"] = float(pre[-1]["notice_to_commit_ms"])
+        line["detail"] = {
+            "save_latency_ms": pre[-1]["save_latency_ms"],
+            "iteration": pre[-1]["iteration"],
+            "n_params": sum(int(np.prod(x.shape))
+                            for x in jax.tree.leaves(loop.state.params)),
+            "async_save": True,
+        }
+    except Exception as e:  # noqa: BLE001 - pre-headline lines must never
+        # kill the run (the headline MFU contract)
+        line["error"] = str(e)[:300]
+    finally:
+        if prev_fault is None:
+            os.environ.pop(resilience.FAULT_ENV, None)
+        else:
+            os.environ[resilience.FAULT_ENV] = prev_fault
+        shutil.rmtree(tmp, ignore_errors=True)
+    return line
+
+
 def moe_dispatch_bench(deadline, peak):
     """Iso-parameter 4-expert/top-2 MoE at the headline geometry, capacity
     vs dropless dispatch MFU (useful-FLOP accounting like
@@ -1132,6 +1223,8 @@ def main():
             print(json.dumps(serve_speculative_bench(deadline)),
                   flush=True)
             print(json.dumps(serve_slo_bench(deadline)), flush=True)
+            # preemption notice budget: SIGTERM -> committed checkpoint
+            print(json.dumps(preempt_save_bench(deadline)), flush=True)
         if want_extras:
             run_extras(deadline, peak, extras)
 

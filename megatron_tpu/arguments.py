@@ -234,6 +234,26 @@ def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
                         "poison window instead of aborting")
     g.add_argument("--max_rollbacks", type=int, default=3,
                    help="abort anyway after this many divergence rollbacks")
+    g.add_argument("--preempt_save_timeout", type=float, default=600.0,
+                   help="deadline (seconds) on the expedited checkpoint a "
+                        "SIGTERM preemption notice forces; past it the "
+                        "process force-exits instead of overstaying the "
+                        "notice window (0 disables the deadline)")
+    g.add_argument("--step_timeout_s", type=float, default=0.0,
+                   help="hang watchdog: if no step completes for this many "
+                        "seconds, dump a flight bundle, journal "
+                        "hang_detected, and abort cleanly instead of "
+                        "hanging forever (0 disables; must exceed the "
+                        "longest legitimate step + eval/save stall)")
+    g.add_argument("--replay_check_interval", type=int, default=0,
+                   help="every N steps re-run the jitted step on the "
+                        "retained batch and compare outputs BITWISE — "
+                        "silent-data-corruption sentinel; a mismatch "
+                        "journals sdc_detected and aborts (0 disables)")
+    g.add_argument("--log_data_fingerprint", action="store_true",
+                   help="journal a crc32 of every host batch as data_crc "
+                        "on step records (sample-exactness evidence for "
+                        "elastic resume)")
 
     g = p.add_argument_group("mixed precision")
     g.add_argument("--bf16", action="store_true")
@@ -624,6 +644,10 @@ def args_to_run_config(args) -> RunConfig:
         loss_spike_patience=getattr(args, "loss_spike_patience", 5),
         rollback_on_divergence=getattr(args, "rollback_on_divergence", False),
         max_rollbacks=getattr(args, "max_rollbacks", 3),
+        preempt_save_timeout=getattr(args, "preempt_save_timeout", 600.0),
+        step_timeout_s=getattr(args, "step_timeout_s", 0.0),
+        replay_check_interval=getattr(args, "replay_check_interval", 0),
+        log_data_fingerprint=getattr(args, "log_data_fingerprint", False),
         log_interval=args.log_interval,
         tensorboard_dir=args.tensorboard_dir,
         wandb_logger=args.wandb_logger,

@@ -497,6 +497,34 @@ class TrainingConfig:
     # re-diverges every time is genuinely diverging, not unlucky
     max_rollbacks: int = 3
 
+    # preemption + elastic resume + sentinels
+    # (docs/fault_tolerance.md "Preemption and elastic resume"):
+    # deadline on the expedited SIGTERM-notice checkpoint — the first
+    # SIGTERM drains the async pipeline and forces a SYNCHRONOUS
+    # committed save (bypassing --save_interval); if the commit misses
+    # this many seconds the process force-exits
+    # resilience.PREEMPT_TIMEOUT_EXIT_CODE instead of overstaying the
+    # notice window. 0 disables the deadline (wait however long).
+    preempt_save_timeout: float = 600.0
+    # step-deadline hang watchdog (training/resilience.py StepWatchdog):
+    # if no step completes for this many seconds, dump a flight-recorder
+    # bundle, journal `hang_detected`, and abort cleanly with
+    # resilience.HANG_EXIT_CODE instead of hanging until the scheduler's
+    # timeout kill destroys the evidence. Must exceed the longest
+    # legitimate heartbeat gap (a step + the worst eval/save stall).
+    # 0 disables.
+    step_timeout_s: float = 0.0
+    # opt-in silent-data-corruption sentinel: every N steps re-run the
+    # jitted train step on the retained (state, batch) and compare the
+    # committed outputs BITWISE; a mismatch journals `sdc_detected` with
+    # the leaf paths and aborts (resilience.SDCError). Costs one state
+    # copy + one extra step per check. 0 disables.
+    replay_check_interval: int = 0
+    # journal a crc32 fingerprint of every host batch (`data_crc` on step
+    # records) — the sample-identity evidence elastic-resume tests diff
+    # across topologies; negligible cost, off by default
+    log_data_fingerprint: bool = False
+
     # logging
     log_interval: int = 100
     tensorboard_dir: Optional[str] = None
@@ -600,6 +628,18 @@ class TrainingConfig:
             raise ValueError(
                 "metrics_lag must be >= 0 (0 fetches metrics inside each "
                 "step, the synchronous behavior)")
+        if self.preempt_save_timeout < 0:
+            raise ValueError(
+                "preempt_save_timeout must be >= 0 seconds (0 disables "
+                "the preemption-save deadline)")
+        if self.step_timeout_s < 0:
+            raise ValueError(
+                "step_timeout_s must be >= 0 seconds (0 disables the "
+                "step-deadline hang watchdog)")
+        if self.replay_check_interval < 0:
+            raise ValueError(
+                "replay_check_interval must be >= 0 steps (0 disables "
+                "the SDC replay check)")
         if self.train_iters is None and self.train_samples is None:
             pass  # inference / tooling use
         return self

@@ -49,7 +49,18 @@ class PretrainingSampler:
 
 class PretrainingRandomSampler:
     """Epoch-seeded random order with exact resume inside an epoch
-    (ref: MegatronPretrainingRandomSampler)."""
+    (ref: MegatronPretrainingRandomSampler).
+
+    Elastic-resume caveat: the epoch size, per-rank bucket partition,
+    and permutation are all functions of micro_batch_size * dp_size, so
+    the random ORDER is only invariant across a topology change when the
+    sampler is driven at GLOBAL-batch granularity — which is how the
+    entry points use it (pretrain_gpt.py passes the whole global batch
+    as micro_batch_size with data_parallel_size=1, the single-controller
+    shape). Per-rank constructions (micro_batch_size=per-rank share,
+    data_parallel_size=dp) re-partition the buckets when dp changes and
+    do NOT preserve sample order; the sequential PretrainingSampler is
+    order-invariant either way."""
 
     def __init__(self, total_samples: int, consumed_samples: int,
                  micro_batch_size: int, data_parallel_rank: int,
@@ -67,7 +78,19 @@ class PretrainingRandomSampler:
         active_total = self.total_samples - self.last_batch_size
         epoch = self.consumed_samples // active_total
         current_epoch_samples = self.consumed_samples % active_total
-        assert current_epoch_samples % self.micro_batch_times_dp == 0
+        if current_epoch_samples % self.micro_batch_times_dp:
+            # a real error, not an assert (stripped under -O): resuming
+            # with a batch geometry that doesn't divide the restored
+            # consumed_samples watermark would silently misalign the
+            # random order — the elastic-resume contract is that the
+            # GLOBAL batch (and hence the watermark granularity) stays
+            # invariant across topology changes
+            raise ValueError(
+                f"consumed_samples={self.consumed_samples} is not a "
+                f"multiple of micro_batch*dp={self.micro_batch_times_dp} "
+                "within the epoch — the resumed batch geometry does not "
+                "match the one the watermark was written with (keep "
+                "global_batch_size invariant across topology changes)")
 
         bucket_size = (active_total // self.micro_batch_times_dp) \
             * self.micro_batch_size

@@ -121,11 +121,16 @@ class RunTelemetry:
         self.goodput_gauge.set(rep["goodput"])
         return rep
 
-    def close(self) -> None:
-        """Final goodput event, then tear down server/recorder/journal."""
+    def close(self, **fields: Any) -> None:
+        """Final goodput event, then tear down server/recorder/journal.
+
+        fields land on the run_end record — the train loop passes
+        received_signal so a post-mortem can tell a cluster preemption
+        (SIGTERM) from an operator interrupt (SIGINT) without scraping
+        stderr."""
         try:
             self.emit("goodput", final=True, **self.goodput_report())
-            self.emit("run_end")
+            self.emit("run_end", **fields)
         finally:
             if self.flight is not None:
                 self.flight.stop()

@@ -132,7 +132,8 @@ def compute_manifest(path: str, hashes: bool = True) -> Dict[str, Any]:
     return files
 
 
-def write_manifest(path: str, iteration: int) -> str:
+def write_manifest(path: str, iteration: int,
+                   tags: Tuple[str, ...] = ()) -> str:
     """Write the commit record. This is the LAST file written into the
     staging dir: its presence means every byte listed in it was already on
     disk when it was created.
@@ -146,6 +147,11 @@ def write_manifest(path: str, iteration: int) -> str:
     opt-out."""
     man = {"format": 1, "iteration": int(iteration),
            "files": compute_manifest(path)}
+    if tags:
+        # provenance tags ride in the commit record (e.g. "preemption":
+        # the checkpoint a SIGTERM notice forced — retention treats the
+        # newest one as unprunable, see prune_checkpoints)
+        man["tags"] = sorted(set(tags))
     out = os.path.join(path, MANIFEST)
     tmp = out + ".tmp"
     with open(tmp, "w") as f:
@@ -197,6 +203,16 @@ def verify_checkpoint(path: str, deep: bool = False) -> Tuple[bool, str]:
                 return False, (f"checksum mismatch for {rel}: manifest "
                                f"{info['crc32']}, on disk {crc}")
     return True, f"{len(files)} files ok" + (" (deep)" if deep else "")
+
+
+def checkpoint_tags(path: str) -> Tuple[str, ...]:
+    """Provenance tags recorded in a checkpoint's manifest (() when the
+    manifest is missing/unreadable or carries none)."""
+    try:
+        with open(os.path.join(path, MANIFEST)) as f:
+            return tuple(json.load(f).get("tags") or ())
+    except (OSError, ValueError):
+        return ()
 
 
 def committed_iterations(load: str) -> List[int]:
@@ -268,7 +284,11 @@ def prune_checkpoints(save: str, keep_latest_k: int,
     Only manifested (post-atomic-scheme) checkpoints are eligible: legacy
     dirs without a manifest are never auto-deleted, nor is whatever the
     tracker currently points at (even if it would age out — the tracker
-    must never dangle). Returns the pruned iterations."""
+    must never dangle). The newest checkpoint tagged "preemption" is also
+    never pruned regardless of keep_latest_k: it is the state the cluster
+    forced out the door and the resume anchor a post-preemption restart
+    depends on (older preemption checkpoints age out normally). Returns
+    the pruned iterations."""
     if not keep_latest_k or keep_latest_k < 1:
         return []
     committed = [it for it in committed_iterations(save)
@@ -278,6 +298,10 @@ def prune_checkpoints(save: str, keep_latest_k: int,
     tracked = read_tracker(save)
     if tracked is not None:
         keep.add(tracked)
+    preempted = [it for it in committed
+                 if "preemption" in checkpoint_tags(checkpoint_dir(save, it))]
+    if preempted:
+        keep.add(preempted[-1])
     pruned = []
     for it in committed:
         if it not in keep:
@@ -336,7 +360,7 @@ def resolve_load_iteration(load: str, iteration: Optional[int] = None,
 
 def _finalize(save: str, stage: str, iteration: int, consumed_samples: int,
               config: Optional[Dict[str, Any]], keep_latest_k: Optional[int],
-              log=None) -> str:
+              log=None, tags: Tuple[str, ...] = ()) -> str:
     """Commit a staged checkpoint: meta.json -> manifest (commit record) ->
     os.replace into place -> tracker bump -> retention. Runs after the
     orbax write has fully finished (sync caller or async finalizer thread).
@@ -359,7 +383,7 @@ def _finalize(save: str, stage: str, iteration: int, consumed_samples: int,
     # staging dir — the case atomic saves exist for
     resilience.maybe_kill("kill_during_save", iteration)
     resilience.maybe_sleep("slow_save")
-    write_manifest(stage, iteration)
+    write_manifest(stage, iteration, tags=tags)
     displaced = None
     if os.path.isdir(final):
         # re-save of the same iteration (fallback resume past a corrupt
@@ -395,6 +419,7 @@ def save_checkpoint(
     iteration: int,
     consumed_samples: int = 0,
     config: Optional[Dict[str, Any]] = None,
+    tags: Tuple[str, ...] = (),
 ) -> str:
     """Synchronous atomic save: stage -> orbax write -> manifest commit ->
     rename -> tracker bump (ref: save_checkpoint, checkpointing.py:243-337).
@@ -407,7 +432,7 @@ def save_checkpoint(
     ckptr.save(os.path.join(stage, "state"), state, force=True)
     ckptr.wait_until_finished()
     return _finalize(save, stage, iteration, consumed_samples, config,
-                     keep_latest_k=None)
+                     keep_latest_k=None, tags=tags)
 
 
 class AsyncCheckpointSaver:
@@ -444,7 +469,8 @@ class AsyncCheckpointSaver:
 
     def save(self, state: TrainState, iteration: int,
              consumed_samples: int = 0,
-             config: Optional[Dict[str, Any]] = None) -> None:
+             config: Optional[Dict[str, Any]] = None,
+             tags: Tuple[str, ...] = ()) -> None:
         self.wait()  # barrier: at most one checkpoint in flight
         stage = _staging_dir(self.save_dir, iteration)
         shutil.rmtree(stage, ignore_errors=True)
@@ -464,7 +490,7 @@ class AsyncCheckpointSaver:
                 self._ckptr.wait_until_finished()
                 self._last_path = _finalize(
                     self.save_dir, stage, iteration, consumed_samples,
-                    config, self.keep_latest_k, self.log)
+                    config, self.keep_latest_k, self.log, tags=tags)
                 if self.journal is not None:
                     self.journal.emit(
                         "checkpoint_commit", iteration=iteration,
@@ -501,6 +527,19 @@ class AsyncCheckpointSaver:
         if self._error is not None:
             err, self._error = self._error, None
             raise err
+
+
+def saved_run_config(load: str, iteration: Optional[int] = None
+                     ) -> Dict[str, Any]:
+    """The run config recorded in the checkpoint a resume from `load`
+    would read (same iteration resolution as load_checkpoint); {} when
+    the checkpoint predates config recording. Used by the train loop's
+    elastic-resume detection to compare the saved topology with the
+    current one (docs/fault_tolerance.md "Preemption and elastic
+    resume")."""
+    it, _ = resolve_load_iteration(load, iteration)
+    with open(os.path.join(checkpoint_dir(load, it), "meta.json")) as f:
+        return json.load(f).get("config") or {}
 
 
 # -- load --------------------------------------------------------------------

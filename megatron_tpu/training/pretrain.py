@@ -20,7 +20,9 @@ import dataclasses
 import os
 import signal as signal_module
 import sys
+import threading
 import time
+import warnings
 from typing import Any, Callable, Dict, Iterator, Optional, Tuple
 
 import jax
@@ -205,6 +207,14 @@ class TrainLoop:
         self.iteration = 0
         self.consumed_samples = 0
 
+        # the config recorded in every checkpoint: the RunConfig dict with
+        # the RESOLVED data-parallel degree (ParallelConfig.data_parallel
+        # is usually None/derived) — the next resume compares it against
+        # its own topology to detect an elastic dp change (_load)
+        self._save_config = run_cfg.to_dict()
+        self._save_config["parallel"]["data_parallel"] = self.rt.dp
+        self._elastic_resume: Optional[Dict[str, Any]] = None
+
         if run_cfg.training.load:
             self._load()
         self.state = self._permute_state(self.state, to_placed=True)
@@ -241,6 +251,17 @@ class TrainLoop:
         self._prefetcher: Optional[prefetch.DevicePrefetcher] = None
         self._pf_credited = (0.0, 0.0)
         self.host_sync_points = 0
+
+        # preemption / hang / SDC sentinels (training/resilience.py;
+        # docs/fault_tolerance.md "Preemption and elastic resume"):
+        # which signal(s) ended the run (run_end's received_signal), the
+        # step-deadline watchdog (armed in _train_inner when
+        # --step_timeout_s > 0), and the per-iteration host-batch
+        # fingerprints (--log_data_fingerprint) consumed by
+        # _process_record
+        self._exit_signal: Optional[str] = None
+        self._watchdog: Optional[resilience.StepWatchdog] = None
+        self._batch_fps: Dict[int, str] = {}
 
         sp = run_cfg.parallel.sequence_parallel
 
@@ -293,6 +314,10 @@ class TrainLoop:
                 async_loop=t.async_loop, prefetch_depth=t.prefetch_depth,
                 metrics_lag=t.metrics_lag,
                 compilation_cache_dir=t.compilation_cache_dir)
+            if self._elastic_resume is not None:
+                # the topology changed under the run (detected in _load,
+                # journaled here because telemetry outlives _load)
+                self.telemetry.emit("elastic_resume", **self._elastic_resume)
 
     # -- placed (interleaved) layer order -----------------------------------
 
@@ -324,7 +349,7 @@ class TrainLoop:
             state, it, consumed = checkpointing.load_checkpoint(
                 t.load, self.state, shardings=self.state_shardings,
                 finetune=t.finetune, no_load_optim=t.no_load_optim,
-                config=self.cfg.to_dict())
+                config=self._save_config)
         except FileNotFoundError:
             self.log(f"no checkpoint found in {t.load}, starting fresh")
             return
@@ -333,8 +358,68 @@ class TrainLoop:
         self.consumed_samples = consumed
         self.log(f"loaded checkpoint at iteration {it} "
                  f"(consumed {consumed} samples)")
+        self._detect_topology_change(t)
 
-    def save(self):
+    def _detect_topology_change(self, t):
+        """Elastic resume: the checkpoint layer is topology-free (orbax
+        sharding metadata reshard on load), so a dp change only moves the
+        gradient-accumulation split — the global batch, sample order, and
+        LR schedule stay invariant (MicroBatchCalculator validated that
+        at __init__, with a loud error naming the valid choices when it
+        can't hold). Here we merely detect and record the change so the
+        journal shows it and operators see the re-derivation."""
+        try:
+            saved = checkpointing.saved_run_config(t.load)
+        except (OSError, ValueError, FileNotFoundError):
+            return  # pre-config checkpoint: nothing to compare
+        saved_t = saved.get("training") or {}
+        saved_dp = (saved.get("parallel") or {}).get("data_parallel")
+        saved_mb = saved_t.get("micro_batch_size", t.micro_batch_size)
+        saved_gbs = saved_t.get("global_batch_size", t.global_batch_size)
+        if not saved_dp:
+            return
+        saved_dp, saved_mb = int(saved_dp), int(saved_mb)
+        saved_gbs = int(saved_gbs)
+        gbs = t.global_batch_size
+        if saved_gbs != gbs:
+            # a DIVISIBLE gbs change sails through MicroBatchCalculator,
+            # but it re-times the LR schedule and re-phases sample order
+            # against consumed_samples — legal for a deliberate schedule
+            # change, catastrophic as an accident. Loud, and on the
+            # journal, either way.
+            warnings.warn(
+                f"resuming with --global_batch_size {gbs} but the "
+                f"checkpoint was written at {saved_gbs}: sample order and "
+                f"the LR schedule will DIFFER from the saved run (elastic "
+                f"resume keeps the global batch invariant — only "
+                f"micro_batch_size / data_parallel may change); continuing "
+                "only makes sense as a deliberate schedule change")
+        changed_dp = saved_dp != self.rt.dp
+        changed_mb = saved_mb != t.micro_batch_size
+        if not (changed_dp or changed_mb or saved_gbs != gbs):
+            return
+        accum_from = saved_gbs // max(saved_mb * saved_dp, 1)
+        accum_to = gbs // (t.micro_batch_size * self.rt.dp)
+        self._elastic_resume = {
+            "iteration": self.iteration,
+            "from_dp": saved_dp, "to_dp": self.rt.dp,
+            "from_micro_batch": saved_mb,
+            "to_micro_batch": t.micro_batch_size,
+            "from_global_batch": saved_gbs,
+            "global_batch_size": gbs,
+            "accum_from": accum_from, "accum_to": accum_to,
+        }
+        self.log(
+            f"elastic resume: checkpoint written at data_parallel="
+            f"{saved_dp} x micro_batch={saved_mb} (accumulation "
+            f"{accum_from}), resuming at data_parallel={self.rt.dp} x "
+            f"micro_batch={t.micro_batch_size} (accumulation {accum_to}) "
+            + (f"— WARNING: global batch changed {saved_gbs} -> {gbs}"
+               if saved_gbs != gbs else
+               f"— global batch {gbs}, sample order, and "
+               f"consumed_samples={self.consumed_samples} are unchanged"))
+
+    def save(self, tags: Tuple[str, ...] = ()):
         t = self.cfg.training
         if not t.save:
             return
@@ -351,7 +436,7 @@ class TrainLoop:
                 async_save=t.async_save,
                 journal=(self.telemetry.journal if self.telemetry else None))
         self._saver.save(state, self.iteration, self.consumed_samples,
-                         config=self.cfg.to_dict())
+                         config=self._save_config, tags=tags)
         self.timers("save-checkpoint", 0).stop()
         if self.telemetry is not None:
             # the span above is the train-loop STALL (async: barrier +
@@ -365,6 +450,239 @@ class TrainLoop:
         every exit path (normal return, SIGTERM, exception)."""
         if self._saver is not None:
             self._saver.wait()
+
+    # -- preemption / hang / SDC sentinels -----------------------------------
+
+    def _preempt_save(self, sig) -> None:
+        """Expedited preemption path: the first SIGTERM already drained
+        the metrics pipeline (caller); here the loop forces a SYNCHRONOUS
+        committed checkpoint — bypassing --save_interval, tagged
+        "preemption" in the manifest so retention never prunes it —
+        bounded by --preempt_save_timeout, then journals a `preemption`
+        event with the notice->commit latency. A save that misses the
+        deadline force-exits PREEMPT_TIMEOUT_EXIT_CODE: overstaying a
+        preemption notice means the scheduler's SIGKILL lands mid-write
+        anyway, so dying deliberately with the journal flushed is
+        strictly better evidence."""
+        t = self.cfg.training
+        self._stop_watchdog()  # the preempt deadline takes over
+        first = sig.first_signal()
+        notice_t = first[1] if first else time.monotonic()
+        # the deadline is anchored at the NOTICE's arrival, not at this
+        # call: the in-flight iteration + eval + drain between the two
+        # already spent part of the grace window, and granting the save a
+        # fresh full budget would overstay it — exactly what the knob
+        # exists to prevent. If the budget is effectively gone, a short
+        # floor still lets a small/fast checkpoint make it out the door.
+        budget = (max(t.preempt_save_timeout
+                      - (time.monotonic() - notice_t), 1.0)
+                  if t.preempt_save_timeout else 0.0)
+        timer = None
+        committed = threading.Event()
+        if t.preempt_save_timeout:
+            def _overdue():
+                # timer.cancel() cannot stop a callback already running:
+                # a save that commits right AT the deadline must not be
+                # reported as a timeout after the fact — re-check the
+                # commit flag here and again just before dying
+                if committed.is_set():
+                    return
+                sys.stderr.write(
+                    f"preemption checkpoint exceeded --preempt_save_timeout"
+                    f"={t.preempt_save_timeout}s; forcing exit "
+                    f"{resilience.PREEMPT_TIMEOUT_EXIT_CODE}\n")
+                sys.stderr.flush()
+
+                def _journal_timeout():
+                    if self.telemetry is None:
+                        return
+                    self.telemetry.emit(
+                        "preemption_timeout", iteration=self.iteration,
+                        timeout_s=t.preempt_save_timeout)
+                    if self.telemetry.journal is not None:
+                        try:
+                            self.telemetry.journal.flush()
+                        except OSError:
+                            pass
+
+                # the journal may share the wedged filesystem that
+                # stalled the save — attempt it on a bounded helper so a
+                # dead mount can never stall the forced exit itself (the
+                # same reason the second-signal escape writes only
+                # stderr)
+                jt = threading.Thread(target=_journal_timeout, daemon=True)
+                jt.start()
+                jt.join(timeout=5.0)
+                if committed.is_set():
+                    return
+                os._exit(resilience.PREEMPT_TIMEOUT_EXIT_CODE)
+
+            timer = threading.Timer(budget, _overdue)
+            timer.daemon = True
+            timer.start()
+        try:
+            t0 = time.monotonic()
+            self.save(tags=("preemption",))
+            self._flush_saves()  # commit NOW — the exit must find it durable
+            t1 = time.monotonic()
+        finally:
+            committed.set()
+            if timer is not None:
+                timer.cancel()
+        save_ms = (t1 - t0) * 1e3
+        notice_ms = (t1 - notice_t) * 1e3
+        self.log(f"preemption checkpoint committed at iteration "
+                 f"{self.iteration} (save {save_ms:.0f} ms, "
+                 f"notice->commit {notice_ms:.0f} ms"
+                 + ("" if t.save else "; no --save dir: nothing written")
+                 + ")")
+        if self.telemetry is not None:
+            self.telemetry.emit(
+                "preemption", iteration=self.iteration,
+                signal="SIGTERM", consumed_samples=self.consumed_samples,
+                save_latency_ms=round(save_ms, 1),
+                notice_to_commit_ms=round(notice_ms, 1),
+                save_timeout_s=t.preempt_save_timeout,
+                saved=bool(t.save))
+
+    def _heartbeat(self, note: str) -> None:
+        """Progress beat shared by the flight recorder and the step
+        watchdog — called once per processed record and after save/eval
+        stalls, so both deadline monitors measure the same liveness."""
+        if self.telemetry is not None:
+            self.telemetry.heartbeat(note)
+        if self._watchdog is not None:
+            self._watchdog.beat()
+
+    def _stop_watchdog(self) -> None:
+        if self._watchdog is not None:
+            self._watchdog.stop()
+            self._watchdog = None
+
+    def _on_hang(self, age: float) -> None:
+        """StepWatchdog verdict (runs on the watchdog thread): the loop
+        made no progress past --step_timeout_s. Dump a flight-recorder
+        bundle (reusing the armed recorder when there is one), journal
+        `hang_detected`, and exit HANG_EXIT_CODE cleanly — a diagnosable
+        deliberate abort instead of an infinite hang that ends in an
+        evidence-destroying timeout kill."""
+        t = self.cfg.training
+        stuck_at = self.iteration + 1  # the step in flight
+        self.log(f"step watchdog: no progress for {age:.1f}s "
+                 f"(step_timeout_s={t.step_timeout_s}) at iteration "
+                 f"~{stuck_at} — dumping flight bundle and aborting")
+        bundle = None
+        try:
+            flight = self.telemetry.flight if self.telemetry else None
+            if flight is not None:
+                # both watchdogs armed: park the recorder's own watch
+                # thread first so one hang yields one bundle and one
+                # abort (ours), not a dump/SIGABRT race
+                flight.stop()
+            if flight is None:
+                from megatron_tpu.telemetry.flight_recorder import (
+                    FlightRecorder,
+                )
+
+                base = t.telemetry_dir or t.save
+                out = (os.path.join(base, "flight_bundles") if base
+                       else "flight_bundles")
+                flight = FlightRecorder(
+                    out_dir=out, deadline_s=t.step_timeout_s,
+                    journal=(self.telemetry.journal if self.telemetry
+                             else None), log=self.log)
+            bundle = flight.dump(
+                reason=f"step watchdog: no heartbeat for {age:.1f}s "
+                       f"(step_timeout_s={t.step_timeout_s})")
+            self.log(f"step watchdog: bundle written to {bundle}")
+        except Exception as e:  # noqa: BLE001 - the abort must proceed
+            # even when the bundle can't be written (full disk): a hang
+            # turning into an un-diagnosed but CLEAN abort still beats a
+            # timeout kill
+            self.log(f"step watchdog: bundle dump failed: {e}")
+        if self.telemetry is not None:
+            self.telemetry.emit(
+                "hang_detected", iteration=stuck_at,
+                heartbeat_age_s=round(age, 1),
+                step_timeout_s=t.step_timeout_s, bundle=bundle)
+            if self.telemetry.journal is not None:
+                try:
+                    self.telemetry.journal.flush()
+                except OSError:
+                    pass
+        os._exit(resilience.HANG_EXIT_CODE)
+
+    def _note_fingerprint(self, batch: Dict[str, np.ndarray],
+                          iteration: int) -> Dict[str, np.ndarray]:
+        """Record the host batch's crc32 for `iteration` (keyed so the
+        lagged _process_record can attach it to the right step record).
+        Runs on the prefetcher's worker thread in async mode — dict
+        writes are GIL-atomic and each iteration has its own key."""
+        if self.cfg.training.log_data_fingerprint:
+            self._batch_fps[iteration] = resilience.batch_fingerprint(batch)
+        return batch
+
+    def _snapshot_state(self):
+        """Bitwise copy of the training state on its own shardings — the
+        replay check's pre-step retention. Jitted so sharded leaves stay
+        in place (an eager jnp.copy would gather); the input is NOT
+        donated, so the live state is untouched."""
+        if not hasattr(self, "_snapshot_fn"):
+            self._snapshot_fn = jax.jit(
+                lambda s: jax.tree.map(jnp.copy, s),
+                in_shardings=(self.state_shardings,),
+                out_shardings=self.state_shardings)
+        with jax.sharding.set_mesh(self.rt.mesh):
+            return self._snapshot_fn(self.state)
+
+    def _replay_check(self, pre_state, device_batch, metrics) -> None:
+        """SDC sentinel (--replay_check_interval): re-run the jitted step
+        on the retained (pre-step state, batch) and compare the committed
+        outputs BITWISE. XLA programs are deterministic for fixed inputs
+        — reduction order is compiled in — so ANY drift means the first
+        execution was corrupted (flipped bit in HBM, bad ALU, torn DMA):
+        journal `sdc_detected` with the mismatching leaf paths and abort.
+        The injectable `corrupt_step:ITER` fault flips one params bit
+        after the committed step so this path is deterministically
+        testable."""
+        it = self.iteration  # train_step_placed already advanced it
+        t0 = time.perf_counter()
+        if resilience.fault_active("corrupt_step", it):
+            self.state = dataclasses.replace(
+                self.state,
+                params=resilience.corrupt_params(self.state.params, it))
+        gbs = next(iter(device_batch.values())).shape[0]
+        n_micro = gbs // (self.cfg.training.micro_batch_size * self.rt.dp)
+        step = self._train_step_for(max(n_micro, 1))
+        if not hasattr(self, "_replay_eq_fn"):
+            # device-side comparison: each leaf reduces to one scalar
+            # bool where it lives, so nothing but verdicts crosses to
+            # the host — sharded/multi-host state never gathers
+            self._replay_eq_fn = jax.jit(resilience.bitwise_equal_tree)
+        with jax.sharding.set_mesh(self.rt.mesh):
+            replay_state, replay_metrics = step(pre_state, device_batch)
+            eq = self._replay_eq_fn(
+                {"state": self.state, "metrics": metrics},
+                {"state": replay_state, "metrics": replay_metrics})
+        bad = resilience.mismatch_paths(eq)
+        seconds = time.perf_counter() - t0
+        if self.telemetry is not None:
+            self.telemetry.goodput.attribute("other", seconds)
+            self.telemetry.emit(
+                "replay_check", iteration=it, ok=not bad,
+                seconds=round(seconds, 4))
+        if bad:
+            if self.telemetry is not None:
+                self.telemetry.emit("sdc_detected", iteration=it,
+                                    leaves=bad)
+                if self.telemetry.journal is not None:
+                    self.telemetry.journal.flush()
+            raise resilience.SDCError(
+                f"silent data corruption at iteration {it}: replaying the "
+                f"step on the retained batch diverged bitwise at "
+                f"{len(bad)} leaf path(s), first: {bad}")
+        self.log(f"replay check: iteration {it} bitwise-identical "
+                 f"({seconds * 1e3:.0f} ms)")
 
     def _handle_divergence(self, reason: str,
                            trip_iter: Optional[int] = None) -> bool:
@@ -411,7 +729,7 @@ class TrainLoop:
             try:
                 state, it, consumed = checkpointing.load_checkpoint(
                     src, self._permute_state(self.state, to_placed=False),
-                    shardings=self.state_shardings, config=self.cfg.to_dict())
+                    shardings=self.state_shardings, config=self._save_config)
                 break
             except FileNotFoundError as e:
                 errors.append(str(e))
@@ -588,8 +906,11 @@ class TrainLoop:
         self._prefetcher = prefetch.DevicePrefetcher(
             it, self._put_batch, depth=depth,
             first_iteration=self.iteration + 1,
+            # fingerprint BEFORE fault poisoning: an injected nan_loss
+            # must not read as a data-order change
             transform=(lambda b, i:
-                       resilience.host_batch_faults(b, i, self.log)))
+                       resilience.host_batch_faults(
+                           self._note_fingerprint(b, i), i, self.log)))
         self._pf_credited = (0.0, 0.0)
         return self._prefetcher
 
@@ -702,8 +1023,12 @@ class TrainLoop:
             self._flush_saves()
             if self.telemetry is not None:
                 # after the flush so the last checkpoint_commit event is
-                # in the journal before the final goodput line
-                self.telemetry.close()
+                # in the journal before the final goodput line; run_end
+                # records which signal (if any) ended the run so a
+                # post-mortem can tell preemption from operator interrupt
+                self.telemetry.close(
+                    **({"received_signal": self._exit_signal}
+                       if self._exit_signal else {}))
 
     def _reset_log_window(self) -> None:
         self._win_tokens = 0
@@ -722,6 +1047,7 @@ class TrainLoop:
         a no-rollback trip raises DivergenceError out of here."""
         it = rec["iteration"]
         if "skip_reason" in rec:
+            self._batch_fps.pop(it, None)
             fast_forward = rec["skip_reason"] == "rollback_fast_forward"
             self.log(f"iteration {it}: update skipped "
                      + ("(post-rollback fast-forward)" if fast_forward
@@ -729,7 +1055,7 @@ class TrainLoop:
             if self.telemetry is not None:
                 self.telemetry.emit("step_skipped", iteration=it,
                                     reason=rec["skip_reason"])
-                self.telemetry.heartbeat(f"iteration {it} (skipped)")
+            self._heartbeat(f"iteration {it} (skipped)")
             self._maybe_log_window(rec)
             return False
 
@@ -749,7 +1075,9 @@ class TrainLoop:
         loss_host = float(host["loss"])
         self._last_host_metrics = host
         ntok = rec["ntok"]
+        data_crc = self._batch_fps.pop(it, None)
         if self.telemetry is not None:
+            extra = {"data_crc": data_crc} if data_crc else {}
             self.telemetry.step(
                 it, step_s, ntok, rec["compile_delta"],
                 loss=loss_host,
@@ -760,8 +1088,10 @@ class TrainLoop:
                 tokens_per_s=round(ntok / max(step_s, 1e-9), 1),
                 model_tflops_per_s=round(
                     ntok / max(step_s, 1e-9)
-                    * self._model_flops_per_token / 1e12, 3))
-            self.telemetry.heartbeat(f"iteration {it}")
+                    * self._model_flops_per_token / 1e12, 3),
+                consumed_samples=rec["consumed"],
+                **extra)
+        self._heartbeat(f"iteration {it}")
 
         if self._sentinel is not None:
             streak = host.get("skip_streak")
@@ -896,6 +1226,12 @@ class TrainLoop:
         with DistributedSignalHandler() as sig, contextlib.ExitStack() as _s:
             _s.callback(self._profile_stop)
             _s.callback(self._close_prefetcher)
+            if t.step_timeout_s:
+                # hang sentinel: deadline clock starts at the FIRST
+                # processed step, so the initial compile is exempt
+                self._watchdog = resilience.StepWatchdog(
+                    t.step_timeout_s, self._on_hang).start()
+                _s.callback(self._stop_watchdog)
             data_iter = None
             current_gbs = None
 
@@ -914,6 +1250,7 @@ class TrainLoop:
                 trajectory, and the contaminated logging window goes too."""
                 nonlocal data_iter, current_gbs
                 pending.clear()
+                self._batch_fps.clear()
                 self._close_prefetcher()
                 data_iter = None
                 current_gbs = None
@@ -986,12 +1323,46 @@ class TrainLoop:
                                         if fast_forward else "skip_iters")})
                 else:
                     resilience.maybe_kill("kill_at", self.iteration + 1)
+                    # a preemption NOTICE at an exact step (the handler
+                    # records it; the expedited save path below runs
+                    # after this iteration completes)
+                    resilience.maybe_signal("preempt_at", self.iteration + 1)
+                    # a wedged collective/device step: only the
+                    # --step_timeout_s watchdog turns this into a flight
+                    # bundle + clean abort
+                    resilience.maybe_hang("hang_step", self.iteration + 1)
+                    replay_due = bool(
+                        t.replay_check_interval
+                        and (self.iteration + 1) % t.replay_check_interval
+                        == 0)
                     if self._prefetcher is None:
-                        # prefetched batches were poisoned by the worker's
-                        # transform (same iteration numbering); the sync
-                        # path poisons here
+                        # prefetched batches were fingerprinted/poisoned
+                        # by the worker's transform (same iteration
+                        # numbering); the sync path does both here
+                        batch = self._note_fingerprint(
+                            batch, self.iteration + 1)
                         batch = resilience.host_batch_faults(
                             batch, self.iteration + 1, self.log)
+                        if replay_due:
+                            # the replay needs the PLACED batch retained;
+                            # transfer it here and take the placed path
+                            batch = self._transfer(batch)
+                    if self._watchdog is not None:
+                        key = (self.fixed_num_microbatches
+                               or max(gbs // (t.micro_batch_size
+                                              * self.rt.dp), 1))
+                        if (key not in self._step_cache
+                                or (replay_due
+                                    and not hasattr(self, "_replay_eq_fn"))):
+                            # fresh jit level (rampup boundary, first
+                            # replay check): the multi-minute compile
+                            # ahead is not a hang — go dormant until the
+                            # next completed-step beat, same policy as
+                            # the startup compile exemption
+                            self._watchdog.pause()
+                    # the replay check re-runs this step from a bitwise
+                    # state copy and compares outputs (SDC sentinel)
+                    pre_state = self._snapshot_state() if replay_due else None
                     # forward + backward + optimizer are ONE fused jit
                     # region here (the reference's separate spans,
                     # training.py:500-525, would break that fusion);
@@ -1000,7 +1371,7 @@ class TrainLoop:
                                     if self.telemetry is not None else None)
                     tm = self.timers("forward-backward-optimizer", 0)
                     tm.start()
-                    if self._prefetcher is not None:
+                    if self._prefetcher is not None or replay_due:
                         metrics = self.train_step_placed(batch)
                     else:
                         metrics = self.train_step(batch)
@@ -1009,6 +1380,8 @@ class TrainLoop:
                     # full device step); lag K defers it to _process_record
                     host = self._fetch_metrics(metrics) if lag == 0 else None
                     tm.stop()
+                    if replay_due:
+                        self._replay_check(pre_state, batch, metrics)
                     ntok = int(batch.get(
                         "tokens", next(iter(batch.values()))).size)
                     pending.append({
@@ -1033,6 +1406,9 @@ class TrainLoop:
                     if drain(0):
                         on_rollback()
                         continue
+                    if self._watchdog is not None and self.eval_step is None:
+                        # first eval compiles the eval step — not a hang
+                        self._watchdog.pause()
                     self.timers("eval-time", 0).start()
                     ev = self.evaluate(valid_iter_factory(), t.eval_iters)
                     self.timers("eval-time", 0).stop()
@@ -1041,8 +1417,7 @@ class TrainLoop:
                             "eval", self.timers.last_s("eval-time"),
                             iteration=self.iteration,
                             lm_loss=float(ev["lm_loss"]))
-                        self.telemetry.heartbeat(
-                            f"iteration {self.iteration} (post-eval)")
+                    self._heartbeat(f"iteration {self.iteration} (post-eval)")
                     extra = " | ".join(f"{k}: {v:.4f}" for k, v in ev.items()
                                        if k not in ("lm_loss", "ppl"))
                     self.log(f"validation | lm loss: {ev['lm_loss']:.6f} | "
@@ -1053,11 +1428,22 @@ class TrainLoop:
                     self.writer.flush()
 
                 should_exit = False
+                preempting = False
                 received = sig.signals_received()
                 if received:
                     names = ",".join(
                         signal_module.Signals(s).name for s in received)
-                    self.log(f"received {names}, checkpointing and exiting")
+                    self._exit_signal = names
+                    # SIGTERM is a cluster preemption NOTICE: take the
+                    # expedited path (drain, forced SYNCHRONOUS committed
+                    # save bypassing --save_interval, bounded by
+                    # --preempt_save_timeout, journaled `preemption`).
+                    # SIGINT (operator Ctrl-C) keeps the ordinary
+                    # checkpoint-and-exit; run_end records which arrived.
+                    preempting = signal_module.SIGTERM in received
+                    self.log(f"received {names}, checkpointing and exiting"
+                             + (" (preemption notice: expedited "
+                                "synchronous save)" if preempting else ""))
                     should_exit = True
                 if t.exit_interval and self.iteration % t.exit_interval == 0:
                     should_exit = True
@@ -1075,10 +1461,11 @@ class TrainLoop:
                     if drain(0):
                         on_rollback()
                         continue
-                    self.save()
-                    if self.telemetry is not None:
-                        self.telemetry.heartbeat(
-                            f"iteration {self.iteration} (post-save)")
+                    if preempting:
+                        self._preempt_save(sig)
+                    else:
+                        self.save()
+                    self._heartbeat(f"iteration {self.iteration} (post-save)")
                 if should_exit:
                     return self.state
                 last_saved = self.iteration if saved_now else None

@@ -25,6 +25,20 @@ provides
                               uncommitted staging dir behind
       kill_at:ITER            SIGKILL right before running iteration ITER
                               (a preemption that missed the SIGTERM grace)
+      preempt_at:ITER         self-deliver SIGTERM right before iteration
+                              ITER — a cluster preemption NOTICE at an
+                              exact step, driving the expedited
+                              checkpoint-and-exit path (pretrain.py
+                              _preempt_save) deterministically
+      hang_step:ITER          wedge the train loop forever right before
+                              iteration ITER — a hung collective/device
+                              step; only the --step_timeout_s watchdog
+                              (StepWatchdog below) turns it into a flight
+                              bundle + clean abort
+      corrupt_step:ITER       flip one bit in the params after iteration
+                              ITER's update commits — simulated silent
+                              data corruption; detected by the opt-in
+                              --replay_check_interval integrity replay
       nan_loss:ITER[:N]       poison the batch loss_mask for iterations
                               [ITER, ITER+N) (default N=1) so the loss and
                               grads go non-finite through the REAL skip
@@ -62,11 +76,21 @@ import math
 import os
 import signal
 import sys
-from typing import Dict, Optional, Tuple
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 FAULT_ENV = "MEGATRON_TPU_FAULT"
+
+#: exit code of the step watchdog's clean abort on a detected hang
+#: (EX_SOFTWARE): the supervisor sees a deliberate failure with a flight
+#: bundle on disk, not a timeout kill that destroyed the evidence
+HANG_EXIT_CODE = 70
+#: exit code when a preemption checkpoint misses --preempt_save_timeout
+#: (EX_TEMPFAIL): the notice window closed with the save still in flight
+PREEMPT_TIMEOUT_EXIT_CODE = 75
 
 _parse_cache: Tuple[Optional[str], Dict[str, Tuple[int, ...]]] = (None, {})
 
@@ -74,6 +98,13 @@ _parse_cache: Tuple[Optional[str], Dict[str, Tuple[int, ...]]] = (None, {})
 class DivergenceError(RuntimeError):
     """Training diverged and the sentinel decided recovery is impossible
     (or was not requested). Carries the full diagnostic in str(e)."""
+
+
+class SDCError(RuntimeError):
+    """The --replay_check_interval integrity replay found a bitwise
+    mismatch between a committed step and its replay from the same
+    (state, batch) — silent data corruption. str(e) names the
+    mismatching leaf paths; the journal carries `sdc_detected`."""
 
 
 def parse_fault_env(value: Optional[str] = None) -> Dict[str, Tuple[int, ...]]:
@@ -143,6 +174,22 @@ def maybe_kill(kind: str, iteration: int) -> None:
         sys.stderr.flush()
         _journal_fault(kind, iteration=iteration)
         os.kill(os.getpid(), signal.SIGKILL)
+
+
+def maybe_signal(kind: str, iteration: int,
+                 signum: int = signal.SIGTERM) -> None:
+    """Self-deliver `signum` if the fault is armed for `iteration` — a
+    preemption NOTICE, as opposed to maybe_kill's unmaskable death: the
+    process's own signal handler sees it and gets to run the expedited
+    checkpoint-and-exit path, exactly like a real scheduler SIGTERM."""
+    if fault_active(kind, iteration):
+        name = signal.Signals(signum).name
+        sys.stderr.write(
+            f"MEGATRON_TPU_FAULT: {kind} firing at iteration {iteration} — "
+            f"delivering {name}\n")
+        sys.stderr.flush()
+        _journal_fault(kind, iteration=iteration, signal=name)
+        os.kill(os.getpid(), signum)
 
 
 #: sleep-fault kinds already journaled once this process (see
@@ -215,6 +262,175 @@ def host_batch_faults(batch: Dict[str, np.ndarray], iteration: int,
             log(f"fault injection: nan_loss poisoning iteration {iteration}")
         return poison_batch(batch)
     return batch
+
+
+def batch_fingerprint(batch: Dict[str, np.ndarray]) -> str:
+    """Order-independent crc32 over every array in a host batch — the
+    cheap sample-identity a resume can be judged against: two runs fed
+    the same sample IDs in the same order produce the same per-step
+    fingerprints regardless of topology (--log_data_fingerprint journals
+    it as `data_crc` on step records; docs/fault_tolerance.md
+    "Preemption and elastic resume"). Computed BEFORE fault poisoning so
+    an injected nan_loss never masquerades as a data-order change."""
+    import zlib
+
+    crc = 0
+    for key in sorted(batch):
+        crc = zlib.crc32(key.encode(), crc)
+        crc = zlib.crc32(np.ascontiguousarray(batch[key]).tobytes(), crc)
+    return f"{crc & 0xFFFFFFFF:08x}"
+
+
+def bitwise_equal_tree(a, b):
+    """Per-leaf bitwise-equality pytree of scalar bools, computed ON
+    DEVICE: floats are bitcast to same-width uints first, so NaN
+    payloads match only bit-for-bit and -0.0 != 0.0 — the contract a
+    replayed step must meet exactly. Jit-friendly and gather-free: each
+    leaf reduces to one replicated scalar where it lives, so it works on
+    sharded (including multi-host) state without pulling tensors to the
+    host — only the booleans ever leave the device."""
+    import jax
+    import jax.numpy as jnp
+
+    def eq(x, y):
+        x, y = jnp.asarray(x), jnp.asarray(y)
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            u = {1: jnp.uint8, 2: jnp.uint16,
+                 4: jnp.uint32, 8: jnp.uint64}[x.dtype.itemsize]
+            x = jax.lax.bitcast_convert_type(x, u)
+            y = jax.lax.bitcast_convert_type(y, u)
+        return jnp.all(x == y)
+
+    return jax.tree.map(eq, a, b)
+
+
+def mismatch_paths(eq_tree, limit: int = 8) -> List[str]:
+    """Leaf paths whose bitwise_equal_tree verdict is False (host fetch
+    of the scalar bools only). [] means identical."""
+    import jax
+    from jax.tree_util import keystr, tree_flatten_with_path
+
+    flat = tree_flatten_with_path(eq_tree)[0]
+    verdicts = jax.device_get([v for _, v in flat])
+    out: List[str] = []
+    for (path, _), ok in zip(flat, verdicts):
+        if not bool(ok):
+            out.append(keystr(path))
+            if len(out) >= limit:
+                break
+    return out
+
+
+def tree_bitwise_mismatch(a, b, limit: int = 8) -> List[str]:
+    """Leaf paths where two same-structure pytrees differ BITWISE (the
+    point: a replayed step must reproduce the committed one exactly, and
+    any drift is evidence of corruption, not noise). One-shot eager form
+    of bitwise_equal_tree + mismatch_paths; the train loop jits the
+    comparison instead (pretrain.py _replay_check) so large sharded
+    states never round-trip through the host."""
+    return mismatch_paths(bitwise_equal_tree(a, b), limit=limit)
+
+
+def corrupt_params(params, iteration: int):
+    """Flip one mantissa bit of the first parameter leaf — simulated
+    silent data corruption (the corrupt_step fault's payload): the model
+    keeps training plausibly, only a bitwise integrity check can see it.
+    Placement (sharding) of the corrupted leaf is preserved."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    arr = np.asarray(leaves[0]).copy()
+    arr.view(np.uint8).flat[0] ^= 1
+    _journal_fault("corrupt_step", iteration=iteration)
+    sys.stderr.write(
+        f"MEGATRON_TPU_FAULT: corrupt_step firing at iteration {iteration} "
+        "— flipped one bit in the first params leaf\n")
+    sys.stderr.flush()
+    leaves[0] = jax.device_put(arr, leaves[0].sharding)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+class StepWatchdog:
+    """Per-step deadline watchdog: turns an infinite hang into a bounded,
+    diagnosable abort.
+
+    The train loop beat()s once per processed step (and after save/eval
+    stalls); a daemon thread fires `on_hang(age_seconds)` ONCE when the
+    heartbeat goes stale past `timeout_s`. The clock starts at the FIRST
+    beat, so the initial multi-minute XLA compile is never judged against
+    a deadline sized for steady-state steps (same policy as the flight
+    recorder). The callback runs on the watchdog thread and is expected
+    not to return (the loop's handler dumps a flight bundle, journals
+    `hang_detected`, and os._exits HANG_EXIT_CODE); if it does return the
+    watchdog stays stopped — one hang, one verdict.
+
+    Deliberately separate from the telemetry FlightRecorder (whose
+    watchdog observes the same heartbeats): the recorder is a coarse
+    liveness monitor that dumps-and-keeps-watching (or SIGABRTs), while
+    this is a per-step DEADLINE with pause() windows for known compiles
+    and a clean conventional exit code — folding the two would couple
+    the train loop's abort policy to the observability layer's. When
+    both are armed the loop's hang handler parks the recorder's thread
+    before dumping, so one hang still yields one bundle and one abort
+    (pretrain.py _on_hang)."""
+
+    def __init__(self, timeout_s: float, on_hang: Callable[[float], None],
+                 poll_s: Optional[float] = None):
+        if timeout_s <= 0:
+            raise ValueError("step watchdog timeout_s must be > 0")
+        self.timeout_s = float(timeout_s)
+        self.on_hang = on_hang
+        self.poll_s = float(poll_s) if poll_s else max(timeout_s / 4, 0.02)
+        self._lock = threading.Lock()
+        self._last_beat: Optional[float] = None
+        self.beats = 0
+        self.fired = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "StepWatchdog":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._watch, name="step-watchdog", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=self.poll_s * 4 + 5)
+
+    def beat(self) -> None:
+        with self._lock:
+            self._last_beat = time.monotonic()
+            self.beats += 1
+
+    def pause(self) -> None:
+        """Go dormant until the next beat — the loop calls this before a
+        step that will trigger a fresh XLA compile (batch-size rampup
+        re-jits per level; first eval), the same reason the clock only
+        starts at the first beat: a legitimate multi-minute compile must
+        never be declared a hang. A REAL hang during a paused window is
+        missed, which is the documented cost of not false-killing
+        healthy compiles."""
+        with self._lock:
+            self._last_beat = None
+
+    def _watch(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            with self._lock:
+                last = self._last_beat
+            if last is None:  # clock starts at the first beat
+                continue
+            age = time.monotonic() - last
+            if age < self.timeout_s:
+                continue
+            self._stop.set()  # single-shot: one hang, one verdict
+            self.fired = True
+            self.on_hang(age)
+            return
 
 
 class DivergenceSentinel:

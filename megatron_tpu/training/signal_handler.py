@@ -19,6 +19,7 @@ from __future__ import annotations
 import os
 import signal
 import sys
+import time
 from types import FrameType
 from typing import Optional, Sequence, Tuple
 
@@ -34,6 +35,7 @@ class DistributedSignalHandler:
         self.signals: Tuple[int, ...] = tuple(signals)
         self.sig = self.signals[0]  # backward-compat attribute
         self._received: list = []
+        self._received_at: list = []
         self._prev: dict = {}
 
     def signals_received(self) -> Tuple[int, ...]:
@@ -41,8 +43,21 @@ class DistributedSignalHandler:
         falsy — when none)."""
         return tuple(self._received)
 
+    def first_signal(self) -> Optional[Tuple[int, float]]:
+        """(signum, time.monotonic arrival) of the first handled signal,
+        or None. The arrival stamp is what preemption latency is measured
+        from: a SIGTERM notice gives a fixed grace budget, and the
+        notice->committed-checkpoint wall time (--preempt_save_timeout,
+        bench `preempt_save_latency_ms`) must be judged against the
+        moment the notice LANDED, not when the loop got around to
+        noticing it."""
+        if not self._received:
+            return None
+        return self._received[0], self._received_at[0]
+
     def __enter__(self) -> "DistributedSignalHandler":
         self._received = []
+        self._received_at = []
 
         def handler(signum: int, frame: Optional[FrameType]):
             if self._received:
@@ -55,6 +70,7 @@ class DistributedSignalHandler:
                 sys.stderr.flush()
                 os._exit(128 + signum)
             self._received.append(signum)
+            self._received_at.append(time.monotonic())
 
         for s in self.signals:
             self._prev[s] = signal.getsignal(s)

@@ -69,9 +69,10 @@ def test_bench_main_emits_one_json_line(monkeypatch):
     with redirect_stdout(buf):
         bench.main()
     lines = [l for l in buf.getvalue().splitlines() if l.strip()]
-    # full (non-quick) runs: the serving metric lines, then the headline
-    # LAST (the only positional contract the driver relies on)
-    assert len(lines) == 5
+    # full (non-quick) runs: the serving metric lines + the preemption
+    # notice-budget line, then the headline LAST (the only positional
+    # contract the driver relies on)
+    assert len(lines) == 6
     serve = json.loads(lines[0])
     assert serve["metric"] == "serve_decode_throughput_toks_per_s"
     assert set(serve) >= {"metric", "value", "unit", "vs_baseline"}
@@ -101,6 +102,10 @@ def test_bench_main_emits_one_json_line(monkeypatch):
     # the percentile block must be populated
     assert slo["value"] > 0 and slo["detail"]["failed"] == 0, slo
     assert set(slo["detail"]["ttft_s"]) == {"p50", "p95", "p99"}
+    pre = json.loads(lines[4])
+    assert pre["metric"] == "preempt_save_latency_ms"
+    assert "error" not in pre, pre
+    assert pre["value"] > 0
     out = json.loads(lines[-1])
     assert out["metric"] == "llama_train_step_mfu"
     assert set(out) >= {"metric", "value", "unit", "vs_baseline", "detail"}
@@ -299,6 +304,25 @@ def test_serve_speculative_bench_speedup_gate(monkeypatch):
     # high-acceptance CPU micro-bench (ISSUE 9 acceptance criterion;
     # measured 2.3-3.0x across quiet runs)
     assert line["vs_baseline"] >= 2.0, line
+
+
+@pytest.mark.slow  # ~12s: one tiny in-process TrainLoop preempted by a
+# real self-delivered SIGTERM; gates the pre-headline
+# preempt_save_latency_ms line (ISSUE 11 satellite) — the notice budget
+# tracked across PRs
+def test_preempt_save_bench_line(monkeypatch):
+    import time
+
+    import bench
+
+    monkeypatch.setenv("MEGATRON_TPU_JAX_CACHE", "")
+    line = bench.preempt_save_bench(time.perf_counter() + 280)
+    assert "error" not in line, line
+    assert line["metric"] == "preempt_save_latency_ms"
+    # SIGTERM -> committed checkpoint: a real positive wall time, and
+    # sane on this host (the tiny model commits in well under a minute)
+    assert 0 < line["value"] < 60_000, line
+    assert line["detail"]["save_latency_ms"] <= line["value"]
 
 
 def test_bench_quick_mode(monkeypatch):

@@ -491,3 +491,79 @@ def test_telemetry_flags_parse_into_config():
     t = args_to_run_config(args).training
     assert t.telemetry_dir is None and t.metrics_port is None
     assert not t.flight_recorder
+
+
+def test_resilience_flags_parse_into_config():
+    """ISSUE 11 knobs: preemption deadline, hang watchdog, SDC replay
+    check, batch fingerprinting."""
+    from megatron_tpu.arguments import args_to_run_config, parse_args
+
+    args = parse_args([
+        "--num_layers", "2", "--hidden_size", "64",
+        "--num_attention_heads", "4",
+        "--preempt_save_timeout", "45", "--step_timeout_s", "30",
+        "--replay_check_interval", "500", "--log_data_fingerprint"])
+    t = args_to_run_config(args).training
+    assert t.preempt_save_timeout == 45.0
+    assert t.step_timeout_s == 30.0
+    assert t.replay_check_interval == 500
+    assert t.log_data_fingerprint
+    # defaults: deadline on, sentinels off
+    args = parse_args(["--num_layers", "2", "--hidden_size", "64",
+                       "--num_attention_heads", "4"])
+    t = args_to_run_config(args).training
+    assert t.preempt_save_timeout == 600.0
+    assert t.step_timeout_s == 0.0 and t.replay_check_interval == 0
+    assert not t.log_data_fingerprint
+    # negatives refuse loudly
+    import pytest as _pytest
+
+    from megatron_tpu.config import TrainingConfig
+
+    for bad in ({"step_timeout_s": -1.0}, {"replay_check_interval": -2},
+                {"preempt_save_timeout": -0.5}):
+        with _pytest.raises(ValueError):
+            TrainingConfig(**bad).validate()
+
+
+def test_telemetry_report_counts_resilience_events(tmp_path):
+    """tools/telemetry_report.py surfaces preemption/hang/SDC/elastic
+    event counts (ISSUE 11 satellite)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import telemetry_report
+    finally:
+        sys.path.pop(0)
+
+    journal = tmp_path / "events.jsonl"
+    events = [
+        {"ts": 1.0, "kind": "run_start", "iteration": 0},
+        {"ts": 2.0, "kind": "step", "iteration": 1, "step_ms": 10.0,
+         "loss": 1.5},
+        {"ts": 3.0, "kind": "preemption", "iteration": 1,
+         "signal": "SIGTERM", "notice_to_commit_ms": 80.0},
+        {"ts": 4.0, "kind": "run_end", "received_signal": "SIGTERM"},
+        {"ts": 5.0, "kind": "run_start", "iteration": 1},
+        {"ts": 6.0, "kind": "elastic_resume", "from_dp": 4, "to_dp": 2},
+        {"ts": 7.0, "kind": "hang_detected", "iteration": 3,
+         "heartbeat_age_s": 12.0},
+        {"ts": 8.0, "kind": "sdc_detected", "iteration": 5,
+         "leaves": ["params['embed']"]},
+        {"ts": 9.0, "kind": "preemption_timeout", "iteration": 7},
+    ]
+    with open(journal, "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    summary = telemetry_report.summarize(
+        telemetry_report.load_journal(str(journal)))
+    assert summary["preemptions"] == 1
+    assert summary["preemption_timeouts"] == 1
+    assert summary["hangs"] == 1
+    assert summary["sdc_detected"] == 1
+    assert summary["elastic_resumes"] == 1
+    text = telemetry_report.render(summary)
+    assert "1 preemptions" in text
+    assert "1 hangs detected" in text
+    assert "1 SDC detected" in text
+    assert "1 elastic resumes" in text
+    assert "1 preempt-save timeouts" in text

@@ -54,10 +54,12 @@ def verify_main(argv=None):
         raise SystemExit(f"no checkpoints found in {args.load}")
     results = []
     for it in iters:
-        ok, detail = checkpointing.verify_checkpoint(
-            checkpointing.checkpoint_dir(args.load, it), deep=args.deep)
+        path = checkpointing.checkpoint_dir(args.load, it)
+        ok, detail = checkpointing.verify_checkpoint(path, deep=args.deep)
         results.append((it, ok))
-        print(f"iter {it:7d}: {'OK     ' if ok else 'INVALID'} {detail}")
+        tags = checkpointing.checkpoint_tags(path)
+        print(f"iter {it:7d}: {'OK     ' if ok else 'INVALID'} {detail}"
+              + (f" [tags: {','.join(tags)}]" if tags else ""))
     tracked = checkpointing.read_tracker(args.load)
     print(f"tracker: {tracked}; newest valid: "
           f"{max((i for i, ok in results if ok), default=None)}")

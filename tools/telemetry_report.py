@@ -83,6 +83,18 @@ def summarize(events: List[Dict[str, Any]], top_n: int = 5) -> Dict[str, Any]:
                    if e.get("kind") == "fault_injection"],
         "divergences": sum(1 for e in events
                            if e.get("kind") == "divergence"),
+        # preemption / hang / SDC sentinel ledger (docs/fault_tolerance.md
+        # "Preemption and elastic resume")
+        "preemptions": sum(1 for e in events
+                           if e.get("kind") == "preemption"),
+        "preemption_timeouts": sum(1 for e in events
+                                   if e.get("kind") == "preemption_timeout"),
+        "hangs": sum(1 for e in events
+                     if e.get("kind") == "hang_detected"),
+        "sdc_detected": sum(1 for e in events
+                            if e.get("kind") == "sdc_detected"),
+        "elastic_resumes": sum(1 for e in events
+                               if e.get("kind") == "elastic_resume"),
     }
     if goodputs:
         # goodput events are cumulative WITHIN one process; a journal that
@@ -288,6 +300,17 @@ def render(summary: Dict[str, Any]) -> str:
         lines.append(f"injected faults: {summary['faults']}")
     if summary.get("divergences"):
         lines.append(f"divergence trips: {summary['divergences']}")
+    resilience_counts = [
+        (k, label) for k, label in (
+            ("preemptions", "preemptions"),
+            ("preemption_timeouts", "preempt-save timeouts"),
+            ("hangs", "hangs detected"),
+            ("sdc_detected", "SDC detected"),
+            ("elastic_resumes", "elastic resumes"))
+        if summary.get(k)]
+    if resilience_counts:
+        lines.append("resilience: " + " | ".join(
+            f"{summary[k]} {label}" for k, label in resilience_counts))
     return "\n".join(lines)
 
 
