@@ -176,7 +176,14 @@ def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
     g = p.add_argument_group("checkpointing")
     g.add_argument("--save", default=None)
     g.add_argument("--load", default=None)
-    g.add_argument("--save_interval", type=int, default=None)
+    g.add_argument("--save_interval", default=None,
+                   help="checkpoint every N steps, or 'auto' to derive the"
+                        " cadence from measured commit latency against the"
+                        " --preempt_save_timeout grace window (journaled "
+                        "as cadence_retune on every change)")
+    g.add_argument("--save_interval_floor", type=int, default=25,
+                   help="lower clamp (steps) on the '--save_interval auto'"
+                        " cadence")
     g.add_argument("--load_iters", type=int, default=None)
     g.add_argument("--finetune", action="store_true")
     g.add_argument("--no_load_optim", action="store_true")
@@ -254,6 +261,19 @@ def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
                    help="journal a crc32 of every host batch as data_crc "
                         "on step records (sample-exactness evidence for "
                         "elastic resume)")
+    g.add_argument("--coordination_dir", default=None,
+                   help="shared directory for the file-backed multi-host "
+                        "agreement seam (signal agreement, peer-death "
+                        "poison records, two-phase checkpoint commit, "
+                        "restart barrier); unset, a jax.process_count()>1 "
+                        "run uses the jax.distributed KV store instead "
+                        "(docs/fault_tolerance.md)")
+    g.add_argument("--peer_death_timeout_s", type=float, default=60.0,
+                   help="declare a peer host dead after this many seconds "
+                        "without a heartbeat; survivors journal "
+                        "peer_abort and exit code 76 instead of wedging "
+                        "in the next collective (0 disables heartbeat "
+                        "detection; poison records still observed)")
 
     g = p.add_argument_group("mixed precision")
     g.add_argument("--bf16", action="store_true")
@@ -438,6 +458,19 @@ def _moe_overrides(args) -> dict:
         if v is not None:
             out[name] = v
     return out
+
+
+def _parse_save_interval(value):
+    """--save_interval takes an int or the literal 'auto' (the autotuned
+    cadence, TrainingConfig.save_interval_auto); anything else is the
+    argparse-grade error the old type=int gave."""
+    if value is None or str(value).lower() == "auto":
+        return None
+    try:
+        return int(value)
+    except ValueError:
+        raise SystemExit(
+            f"--save_interval must be an integer or 'auto' (got {value!r})")
 
 
 def args_to_run_config(args) -> RunConfig:
@@ -627,7 +660,9 @@ def args_to_run_config(args) -> RunConfig:
         seed=args.seed,
         recompute_granularity=args.recompute_granularity,
         save=args.save, load=args.load,
-        save_interval=args.save_interval,
+        save_interval=_parse_save_interval(args.save_interval),
+        save_interval_auto=(str(args.save_interval).lower() == "auto"),
+        save_interval_floor=getattr(args, "save_interval_floor", 25),
         exit_interval=args.exit_interval,
         exit_duration_in_mins=args.exit_duration_in_mins,
         finetune=args.finetune,
@@ -648,6 +683,8 @@ def args_to_run_config(args) -> RunConfig:
         step_timeout_s=getattr(args, "step_timeout_s", 0.0),
         replay_check_interval=getattr(args, "replay_check_interval", 0),
         log_data_fingerprint=getattr(args, "log_data_fingerprint", False),
+        coordination_dir=getattr(args, "coordination_dir", None),
+        peer_death_timeout_s=getattr(args, "peer_death_timeout_s", 60.0),
         log_interval=args.log_interval,
         tensorboard_dir=args.tensorboard_dir,
         wandb_logger=args.wandb_logger,

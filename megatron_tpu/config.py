@@ -525,6 +525,29 @@ class TrainingConfig:
     # across topologies; negligible cost, off by default
     log_data_fingerprint: bool = False
 
+    # multi-host coordination (training/coordination.py;
+    # docs/fault_tolerance.md "Multi-host coordination"): shared directory
+    # for the file-backed agreement seam — signal agreement, peer-death
+    # poison records, two-phase checkpoint commit, restart barrier.
+    # None + jax.process_count() > 1 selects the jax.distributed KV-store
+    # backend automatically; None single-process disables coordination
+    # entirely (byte-identical single-host behavior).
+    coordination_dir: Optional[str] = None
+    # declare a peer dead after this many seconds without a heartbeat (or
+    # immediately on its poison record); survivors exit
+    # resilience.PEER_ABORT_EXIT_CODE with `peer_abort` journaled instead
+    # of wedging in the next collective. 0 disables peer-death detection
+    # (poison records still observed).
+    peer_death_timeout_s: float = 60.0
+
+    # --save_interval auto: derive the checkpoint cadence from measured
+    # commit latency (save_interval ~= (preempt grace - p95 commit) /
+    # p50 step), re-derived as measurements accrue and journaled as
+    # `cadence_retune` on every change (resilience.CheckpointCadenceTuner)
+    save_interval_auto: bool = False
+    # lower clamp on the autotuned cadence, in steps
+    save_interval_floor: int = 25
+
     # logging
     log_interval: int = 100
     tensorboard_dir: Optional[str] = None
@@ -640,6 +663,20 @@ class TrainingConfig:
             raise ValueError(
                 "replay_check_interval must be >= 0 steps (0 disables "
                 "the SDC replay check)")
+        if self.peer_death_timeout_s < 0:
+            raise ValueError(
+                "peer_death_timeout_s must be >= 0 seconds (0 disables "
+                "heartbeat-based peer-death detection)")
+        if self.save_interval_auto and self.save_interval is not None:
+            raise ValueError(
+                "--save_interval auto and a fixed --save_interval are "
+                "mutually exclusive")
+        if self.save_interval_auto and not self.preempt_save_timeout:
+            raise ValueError(
+                "--save_interval auto derives the cadence from the "
+                "--preempt_save_timeout grace window; set a positive one")
+        if self.save_interval_floor < 1:
+            raise ValueError("save_interval_floor must be >= 1 step")
         if self.train_iters is None and self.train_samples is None:
             pass  # inference / tooling use
         return self

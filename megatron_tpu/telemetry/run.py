@@ -66,12 +66,52 @@ class RunTelemetry:
         self.host_syncs = metrics.counter(
             "train_host_syncs_total",
             "blocking device->host transfers issued by the train loop")
+        # resilience event counters (ROADMAP item 5: today's journal-only
+        # events surfaced on /metrics so alerting needs no log scraping):
+        # keyed by the journal kind, incremented transparently by emit()
+        self.event_counters = {
+            kind: metrics.counter(name, help_)
+            for kind, name, help_ in (
+                ("preemption", "train_preemptions_total",
+                 "SIGTERM preemption notices that completed the expedited "
+                 "checkpoint-and-exit path"),
+                ("preemption_timeout", "train_preemption_timeouts_total",
+                 "preemption checkpoints that missed --preempt_save_timeout"
+                 " (forced exit 75)"),
+                ("hang_detected", "train_hangs_total",
+                 "step-watchdog hang verdicts (--step_timeout_s, exit 70)"),
+                ("sdc_detected", "train_sdc_total",
+                 "silent-data-corruption verdicts from the "
+                 "--replay_check_interval bitwise replay"),
+                ("elastic_resume", "train_elastic_resumes_total",
+                 "resumes that re-derived the topology (dp/micro-batch/"
+                 "tp/pp/host-count change)"),
+                ("peer_abort", "train_peer_aborts_total",
+                 "exits taken because a PEER host died or published a "
+                 "poison record (exit 76)"),
+                ("commit_abort", "train_commit_aborts_total",
+                 "two-phase checkpoint commits aborted because the "
+                 "cluster could not agree"),
+                ("cadence_retune", "train_cadence_retunes_total",
+                 "--save_interval auto interval changes"),
+            )
+        }
 
     # -- event plumbing -----------------------------------------------------
 
     def emit(self, kind: str, **fields: Any) -> None:
+        c = self.event_counters.get(kind)
+        if c is not None:
+            c.inc()
         if self.journal is not None:
             self.journal.emit(kind, **fields)
+
+    def journal_sink(self) -> "_CountingJournal":
+        """Journal-shaped object (emit/flush) that ALSO feeds the event
+        counters — for components that hold a journal handle rather than
+        the RunTelemetry (AsyncCheckpointSaver: its commit_abort events
+        must reach train_commit_aborts_total on /metrics)."""
+        return _CountingJournal(self)
 
     def heartbeat(self, note: str = "") -> None:
         if self.flight is not None:
@@ -140,6 +180,23 @@ class RunTelemetry:
                 set_global_journal(None)
                 self.journal.flush()
                 self.journal.close()
+
+
+class _CountingJournal:
+    """EventJournal facade over a RunTelemetry: emit() routes through
+    RunTelemetry.emit (journal + event counters), flush() reaches the
+    underlying journal when one exists. Safe when the run has metrics but
+    no journal (the counters still move; nothing is written)."""
+
+    def __init__(self, rt: RunTelemetry):
+        self._rt = rt
+
+    def emit(self, kind: str, **fields: Any) -> None:
+        self._rt.emit(kind, **fields)
+
+    def flush(self) -> None:
+        if self._rt.journal is not None:
+            self._rt.journal.flush()
 
 
 def for_training(tcfg, log=print, registry: Optional[MetricsRegistry] = None

@@ -360,17 +360,46 @@ def resolve_load_iteration(load: str, iteration: Optional[int] = None,
 
 def _finalize(save: str, stage: str, iteration: int, consumed_samples: int,
               config: Optional[Dict[str, Any]], keep_latest_k: Optional[int],
-              log=None, tags: Tuple[str, ...] = ()) -> str:
+              log=None, tags: Tuple[str, ...] = (),
+              coordinator=None) -> Optional[str]:
     """Commit a staged checkpoint: meta.json -> manifest (commit record) ->
     os.replace into place -> tracker bump -> retention. Runs after the
     orbax write has fully finished (sync caller or async finalizer thread).
 
-    On multi-process runs only process 0 commits; the others merely
-    participated in the collective orbax write."""
+    Multi-host (`coordinator` from training/coordination.py): the commit
+    becomes TWO-PHASE — no host flips its tracker until EVERY host has
+    published `staged(iteration, crc)`, so a death mid-save anywhere in
+    the cluster aborts the commit everywhere (raises
+    coordination.CommitAborted; the staging dir is left for the next
+    cleanup pass and the previous checkpoint stays the cluster-consistent
+    resume point). Two layouts:
+
+      * shared save dir (jax.process_count() > 1, collective orbax
+        write): every host votes once ITS orbax bytes are durable, and
+        only process 0 — after the agreement, i.e. after ALL hosts'
+        writes landed — computes the manifest and commits. (Without the
+        agreement, process 0's independent finalizer could manifest the
+        dir while a peer's write was still in flight.)
+      * per-host save dirs (file-backend clusters of single-process
+        hosts): each host writes its own meta+manifest — the per-host
+        manifest resume verifies — then votes with the manifest's crc32
+        and, on agreement, commits its own dir.
+
+    Without a coordinator the single-host behavior is unchanged (and on
+    multi-process runs only process 0 commits, as before)."""
     save = os.path.abspath(save)
     final = checkpoint_dir(save, iteration)
-    if jax.process_count() > 1 and jax.process_index() != 0:
+    shared_write = jax.process_count() > 1  # one collective orbax dir
+    committer = not shared_write or jax.process_index() == 0
+    coordinated = coordinator is not None and coordinator.num_hosts > 1
+    if not committer and not coordinated:
         return final
+    if coordinated and shared_write:
+        # phase 1, shared dir: "my orbax bytes are durable"; the manifest
+        # can only be computed after every host's bytes landed
+        coordinator.commit_barrier(iteration, crc="")
+        if not committer:
+            return final
     meta = {
         "iteration": int(iteration),
         "consumed_train_samples": int(consumed_samples),
@@ -380,10 +409,16 @@ def _finalize(save: str, stage: str, iteration: int, consumed_samples: int,
     with open(os.path.join(stage, "meta.json"), "w") as f:
         json.dump(meta, f, indent=1)
     # fault injection: a kill here leaves a fully written but UNcommitted
-    # staging dir — the case atomic saves exist for
+    # staging dir — the case atomic saves exist for (and, coordinated, a
+    # host that dies here never votes: the peers' commit aborts)
     resilience.maybe_kill("kill_during_save", iteration)
     resilience.maybe_sleep("slow_save")
-    write_manifest(stage, iteration, tags=tags)
+    manifest_path = write_manifest(stage, iteration, tags=tags)
+    if coordinated and not shared_write:
+        # phase 1, per-host dirs: staged(iteration, crc of the per-host
+        # manifest) — evidence the journal/post-mortem can attribute
+        coordinator.commit_barrier(iteration,
+                                   crc=_crc32_file(manifest_path))
     displaced = None
     if os.path.isdir(final):
         # re-save of the same iteration (fallback resume past a corrupt
@@ -420,6 +455,7 @@ def save_checkpoint(
     consumed_samples: int = 0,
     config: Optional[Dict[str, Any]] = None,
     tags: Tuple[str, ...] = (),
+    coordinator=None,
 ) -> str:
     """Synchronous atomic save: stage -> orbax write -> manifest commit ->
     rename -> tracker bump (ref: save_checkpoint, checkpointing.py:243-337).
@@ -432,7 +468,7 @@ def save_checkpoint(
     ckptr.save(os.path.join(stage, "state"), state, force=True)
     ckptr.wait_until_finished()
     return _finalize(save, stage, iteration, consumed_samples, config,
-                     keep_latest_k=None, tags=tags)
+                     keep_latest_k=None, tags=tags, coordinator=coordinator)
 
 
 class AsyncCheckpointSaver:
@@ -447,16 +483,26 @@ class AsyncCheckpointSaver:
     wait()/save()/close() rather than lost."""
 
     def __init__(self, save: str, keep_latest_k: Optional[int] = None,
-                 log=None, async_save: bool = True, journal=None):
+                 log=None, async_save: bool = True, journal=None,
+                 coordinator=None):
         """journal: optional telemetry EventJournal — checkpoint begin /
         commit events land there (the commit from the finalizer thread,
         which is the point: the journal shows how long after the train
-        loop moved on the checkpoint actually became durable)."""
+        loop moved on the checkpoint actually became durable).
+
+        coordinator: optional coordination.ClusterCoordinator — commits
+        become two-phase (see _finalize): a cluster that cannot agree
+        journals `commit_abort` and the error surfaces at the next
+        save/wait instead of a tracker flipping on some hosts only."""
         self.save_dir = os.path.abspath(save)
         self.keep_latest_k = keep_latest_k
         self.log = log or (lambda _m: None)
         self.async_save = async_save
         self.journal = journal
+        self.coordinator = coordinator
+        #: wall seconds of the most recent successful begin->commit (the
+        #: sample --save_interval auto's cadence tuner feeds on)
+        self.last_commit_seconds: Optional[float] = None
         os.makedirs(self.save_dir, exist_ok=True)
         stale = cleanup_staging(self.save_dir)
         if stale:
@@ -486,16 +532,37 @@ class AsyncCheckpointSaver:
         self._ckptr.save(os.path.join(stage, "state"), state, force=True)
 
         def _finish():
+            from megatron_tpu.training.coordination import CommitAborted
+
             try:
                 self._ckptr.wait_until_finished()
                 self._last_path = _finalize(
                     self.save_dir, stage, iteration, consumed_samples,
-                    config, self.keep_latest_k, self.log, tags=tags)
+                    config, self.keep_latest_k, self.log, tags=tags,
+                    coordinator=self.coordinator)
+                self.last_commit_seconds = round(
+                    _time.perf_counter() - t_begin, 4)
                 if self.journal is not None:
                     self.journal.emit(
                         "checkpoint_commit", iteration=iteration,
                         path=self._last_path, async_save=self.async_save,
-                        seconds=round(_time.perf_counter() - t_begin, 4))
+                        seconds=self.last_commit_seconds)
+            except CommitAborted as e:
+                # the cluster could not agree: the tracker was NOT
+                # flipped here (nor, by the same protocol, anywhere
+                # else) — journal the abort with the reason and surface
+                # the error at the next save/wait
+                self.log(f"checkpoint commit ABORTED at iteration "
+                         f"{iteration}: {e}")
+                if self.journal is not None:
+                    self.journal.emit(
+                        "commit_abort", iteration=iteration, reason=str(e),
+                        host=getattr(self.coordinator, "host", None))
+                    try:
+                        self.journal.flush()
+                    except OSError:
+                        pass
+                self._error = e
             except BaseException as e:  # noqa: BLE001 - re-raised at wait()
                 self._error = e
 

@@ -1,0 +1,752 @@
+"""Cross-process agreement seam for multi-host training.
+
+The reference all-gathers its preemption flag over NCCL so every rank
+agrees to exit together (megatron/dist_signal_handler.py). A
+single-controller JAX *process* has no rank loop to all-gather on — but a
+multi-host cluster runs one JAX process per host, and anything one host
+decides alone (drain on SIGTERM, abort on a hang verdict, commit a
+checkpoint) leaves its peers wedged inside the next collective. This
+module is the agreement point those decisions route through:
+
+  * **signal agreement** — a host that receives a preemption notice
+    publishes it; every host reads the cluster-wide union each loop pass,
+    agrees on a common exit iteration, and takes the SAME expedited
+    drain+checkpoint path (pretrain.py). The journal's `preemption` event
+    records which host the notice landed on (`notice_host`).
+  * **coordinated abort** — the hang watchdog and SDC sentinel publish a
+    poison record before exiting, and a missing heartbeat marks a
+    SIGKILLed peer; every host polls between steps AND from a bounded
+    sideband thread, so peers exit `resilience.PEER_ABORT_EXIT_CODE` with
+    a journaled `peer_abort{host, cause}` within `--peer_death_timeout_s`
+    instead of hanging in a collective until the scheduler's timeout kill.
+  * **two-phase checkpoint commit** — each host publishes
+    `staged(iteration, crc)` once its bytes are durable; only the
+    agreement of ALL hosts lets anyone flip the tracker
+    (checkpointing._finalize), so a mid-save death can never leave the
+    cluster half-committed. Resume runs the inverse: hosts agree on the
+    newest checkpoint valid EVERYWHERE (`agree_resume_iteration`).
+  * **elastic restart barrier** — on startup hosts rendezvous and verify
+    they agree on the topology (`topology_barrier`) before any mesh or
+    collective work, turning a host-count change into a journaled
+    `elastic_resume` (pretrain._detect_topology_change) instead of a
+    coordinator timeout.
+
+Two interchangeable backends, selected by `for_training`:
+
+  * `FileBackend` — records are files under a shared `--coordination_dir`
+    (atomic tmp+os.replace writes). Works between plain subprocesses on
+    one machine (the CPU acceptance tests) and on any shared filesystem;
+    host identity comes from MEGATRON_TPU_COORD_HOST /
+    MEGATRON_TPU_COORD_NUM_HOSTS (default: jax process index/count).
+  * `KVBackend` — the jax.distributed coordination service's key-value
+    store (the same store orbax uses for its barriers). Zero extra
+    infrastructure on a real cluster; records die with the coordinator so
+    restarts can never read a previous incarnation's state.
+
+Staleness: every record carries the publishing host's per-boot nonce and
+is only believed if it matches that host's CURRENT `boot/<host>` record —
+a crashed-and-restarted host's old SIGTERM/abort records are dead on
+arrival (this matters for the file backend, whose directory outlives
+processes; the KV store gets the same filtering for uniformity).
+
+Single-process runs (`jax.process_count() == 1`, no --coordination_dir
+pair) get no coordinator at all: `for_training` returns None and every
+call site keeps its existing single-host behavior byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+COORD_HOST_ENV = "MEGATRON_TPU_COORD_HOST"
+COORD_NUM_HOSTS_ENV = "MEGATRON_TPU_COORD_NUM_HOSTS"
+#: startup rendezvous bound (topology barrier + resume agreement): hosts
+#: may be seconds apart in interpreter/import time, so this is much larger
+#: than the steady-state peer_death timeout. Env-overridable for tests.
+STARTUP_TIMEOUT_ENV = "MEGATRON_TPU_COORD_STARTUP_TIMEOUT_S"
+DEFAULT_STARTUP_TIMEOUT_S = 300.0
+
+
+class CoordinationError(RuntimeError):
+    """A coordination protocol failed to reach agreement (timeout,
+    topology mismatch, no common valid checkpoint)."""
+
+
+class CommitAborted(RuntimeError):
+    """Two-phase checkpoint commit aborted: not every host staged inside
+    the window (peer death, timeout) — the tracker was NOT flipped and
+    the staging dir is left for cleanup."""
+
+
+# ---------------------------------------------------------------------------
+# backends
+# ---------------------------------------------------------------------------
+
+
+class FileBackend:
+    """Records as files under a shared directory.
+
+    Keys are slash paths ("sig/0"); each maps to a file whose write is
+    atomic (tmp + os.replace), so a reader never sees a torn value. The
+    directory is the cluster's shared ground truth: subprocess tests on
+    one machine, NFS/GCS-fuse on real fleets.
+    """
+
+    name = "file"
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        parts = [p for p in key.split("/") if p]
+        return os.path.join(self.root, *parts)
+
+    def put(self, key: str, value: str) -> None:
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(value)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def get_all(self, prefix: str) -> Dict[str, str]:
+        """{suffix: value} for every record under prefix/."""
+        base = self._path(prefix)
+        if not os.path.isdir(base):
+            return {}
+        out: Dict[str, str] = {}
+        for name in os.listdir(base):
+            if name.endswith(".tmp"):
+                continue
+            fp = os.path.join(base, name)
+            if not os.path.isfile(fp):
+                continue
+            try:
+                with open(fp, encoding="utf-8") as f:
+                    out[name] = f.read()
+            except OSError:
+                continue  # racing a concurrent replace; next poll sees it
+        return out
+
+    def delete(self, key: str) -> None:
+        try:
+            os.remove(self._path(key))
+        except OSError:
+            pass
+
+
+class KVBackend:
+    """The jax.distributed coordination service's key-value store.
+
+    Lives exactly as long as the cluster incarnation (the coordinator
+    process), which is the right lifetime for agreement records; no
+    filesystem needed. All keys ride under one namespace prefix so this
+    never collides with orbax's own use of the store.
+    """
+
+    name = "kv"
+
+    def __init__(self, client=None, namespace: str = "megatron_tpu_coord"):
+        if client is None:
+            # the client object is only reachable through jax internals
+            # (jax exposes initialize/shutdown but not the KV store as of
+            # 0.4.x); drift lands here loudly, not in a protocol stall
+            # jaxlint: disable=internal-api - no public accessor for the
+            # distributed KV client; probed once at construction
+            from jax._src import distributed as _dist
+
+            client = _dist.global_state.client
+        if client is None:
+            raise CoordinationError(
+                "jax.distributed is not initialized — the KV coordination "
+                "backend needs the coordination service client")
+        self._client = client
+        self._ns = namespace.rstrip("/")
+
+    def put(self, key: str, value: str) -> None:
+        self._client.key_value_set(f"{self._ns}/{key}", value,
+                                   allow_overwrite=True)
+
+    def get_all(self, prefix: str) -> Dict[str, str]:
+        full = f"{self._ns}/{prefix.rstrip('/')}/"
+        try:
+            entries = self._client.key_value_dir_get(full)
+        except Exception as e:  # noqa: BLE001 - xla surfaces NOT_FOUND as
+            # a bare RuntimeError (and the exact type has drifted across
+            # jaxlibs); an unreadable prefix is an empty one for pollers
+            if "NOT_FOUND" in str(e).upper() or "not found" in str(e):
+                return {}
+            raise
+        return {k[len(full):]: v for k, v in entries}
+
+    def delete(self, key: str) -> None:
+        try:
+            self._client.key_value_delete(f"{self._ns}/{key}")
+        except Exception:  # noqa: BLE001 - deleting a missing key is fine
+            pass
+
+
+# ---------------------------------------------------------------------------
+# coordinator
+# ---------------------------------------------------------------------------
+
+
+class ClusterCoordinator:
+    """The four agreement protocols over a backend.
+
+    One instance per process; `host` in [0, num_hosts). All waits are
+    bounded polls — a protocol that cannot complete reports WHY (peer
+    abort seen, peer heartbeat stale, timeout) instead of hanging.
+    """
+
+    def __init__(self, backend, host: int, num_hosts: int,
+                 peer_death_timeout_s: float = 60.0,
+                 log: Callable[[str], None] = None,
+                 poll_s: Optional[float] = None):
+        if num_hosts < 2:
+            raise ValueError(
+                f"ClusterCoordinator needs num_hosts >= 2 (got {num_hosts});"
+                " single-process runs use no coordinator at all")
+        if not (0 <= host < num_hosts):
+            raise ValueError(f"host {host} outside [0, {num_hosts})")
+        self.backend = backend
+        self.host = int(host)
+        self.num_hosts = int(num_hosts)
+        self.peer_death_timeout_s = float(peer_death_timeout_s)
+        self.log = log or (lambda _m: None)
+        self.poll_s = (float(poll_s) if poll_s
+                       else max(0.05, min(1.0,
+                                          self.peer_death_timeout_s / 5
+                                          or 0.5)))
+        self.boot = uuid.uuid4().hex
+        # wipe own previous-incarnation records BEFORE publishing the new
+        # boot nonce (file backend: the dir outlives processes)
+        for kind in ("sig", "abort", "hb", "preempt_ack", "resume", "topo"):
+            self.backend.delete(f"{kind}/{self.host}")
+        self.backend.put(f"boot/{self.host}", self.boot)
+        self._hb_n = 0
+        self._signals_published: Tuple[str, ...] = ()
+        # peer heartbeat staleness tracking: host -> (last value, local
+        # monotonic time the value last CHANGED). Wall clocks are never
+        # compared across hosts. _peer_seen: peers that have EVER
+        # published a heartbeat — until then the staleness threshold is
+        # startup-grade (a peer still booting its interpreter must not
+        # read as dead).
+        self._peer_hb: Dict[int, Tuple[str, float]] = {}
+        self._peer_seen: set = set()
+        self._watchdog: Optional[_SidebandWatchdog] = None
+        # per-iteration commit ATTEMPT counter: a re-save of the same
+        # iteration in one incarnation (divergence rollback re-traverses
+        # committed iterations) must never be satisfied by the previous
+        # attempt's leftover votes — see commit_barrier
+        self._commit_attempts: Dict[int, int] = {}
+        # sideband-maintained snapshots: the train loop reads these
+        # instead of hitting the backend every step (see
+        # cluster_signals(cached=True) / exit_pending(cached=True))
+        self._sig_cache: Optional[Dict[int, Dict[str, Any]]] = None
+        self._ack_cache: Optional[Dict[int, Dict[str, Any]]] = None
+
+    # -- record plumbing ----------------------------------------------------
+
+    def _put(self, key: str, **fields: Any) -> None:
+        rec = dict(fields)
+        rec["boot"] = self.boot
+        rec["host"] = self.host
+        self.backend.put(key, json.dumps(rec, separators=(",", ":")))
+
+    def _fresh(self, prefix: str) -> Dict[int, Dict[str, Any]]:
+        """{host: record} under prefix, keeping only records whose boot
+        nonce matches the publisher's CURRENT boot record (stale
+        incarnations are invisible)."""
+        boots = self.backend.get_all("boot")
+        out: Dict[int, Dict[str, Any]] = {}
+        for name, raw in self.backend.get_all(prefix).items():
+            try:
+                rec = json.loads(raw)
+                h = int(rec.get("host", name))
+            except (ValueError, TypeError):
+                continue
+            if boots.get(str(h)) != rec.get("boot"):
+                continue
+            out[h] = rec
+        return out
+
+    def _wait_all(self, prefix: str, timeout_s: float,
+                  what: str) -> Dict[int, Dict[str, Any]]:
+        """Poll until every host has a fresh record under prefix.
+
+        Aborts on EVIDENCE, not on a wall-clock guess: a peer's poison
+        record or a heartbeat gone stale past peer_death_timeout_s ends
+        the wait immediately with the cause — while a peer that is slow
+        but demonstrably alive (still heartbeating through its sideband
+        thread, e.g. mid-compile on a loaded machine) extends the wait up
+        to the hard `timeout_s` deadline. That asymmetry is what keeps a
+        two-phase commit from aborting — or an exit agreement from going
+        solo — just because one host's startup took longer than a knob."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            recs = self._fresh(prefix)
+            if len(recs) >= self.num_hosts:
+                return recs
+            abort = self.peer_abort()
+            if abort is not None:
+                raise CoordinationError(
+                    f"{what}: peer host {abort['host']} aborted "
+                    f"({abort.get('cause')}) while waiting for "
+                    f"{self.num_hosts - len(recs)} host(s)")
+            dead = self.dead_peer()
+            if dead is not None and dead not in recs:
+                raise CoordinationError(
+                    f"{what}: peer host {dead} stopped heartbeating "
+                    f"(peer_death_timeout_s={self.peer_death_timeout_s:g})"
+                    f" before contributing")
+            if time.monotonic() >= deadline:
+                missing = sorted(set(range(self.num_hosts)) - set(recs))
+                raise CoordinationError(
+                    f"{what}: hosts {missing} missing after {timeout_s:.1f}s"
+                    f" (have {sorted(recs)})")
+            time.sleep(self.poll_s)
+
+    # -- protocol 4: startup/topology barrier --------------------------------
+
+    def topology_barrier(self, timeout_s: Optional[float] = None
+                         ) -> Dict[int, Dict[str, Any]]:
+        """Rendezvous all hosts and verify they agree on num_hosts before
+        any mesh/collective work. Returns the per-host records. A
+        disagreement (one host relaunched with a different world size) is
+        a loud CoordinationError here, not a coordinator timeout three
+        layers down."""
+        timeout_s = timeout_s if timeout_s is not None else startup_timeout_s()
+        self._put(f"topo/{self.host}", num_hosts=self.num_hosts,
+                  backend=self.backend.name)
+        recs = self._wait_all("topo", timeout_s, "topology barrier")
+        sizes = {h: r.get("num_hosts") for h, r in recs.items()}
+        if set(sizes.values()) != {self.num_hosts}:
+            raise CoordinationError(
+                f"topology disagreement: per-host num_hosts {sizes} — "
+                "every host must be launched with the same world size")
+        return recs
+
+    # -- protocol 1: signal agreement ---------------------------------------
+
+    def publish_signals(self, names: Sequence[str]) -> None:
+        """Publish the signals THIS host's OS handler received (loop-pass
+        cadence; idempotent per set of names)."""
+        names = tuple(names)
+        if names == self._signals_published:
+            return
+        self._signals_published = names
+        self._put(f"sig/{self.host}", signals=list(names), ts=time.time())
+
+    def cluster_signals(self, cached: bool = False
+                        ) -> Dict[int, Dict[str, Any]]:
+        """Fresh signal records from every host that received one locally
+        ({} when no notice anywhere). cached=True serves the sideband
+        thread's last snapshot when one is being maintained — the train
+        loop reads this every step, and a direct read would cost backend
+        round-trips (directory listings on NFS/GCS-fuse) on 100% of steps
+        for an event that happens once per run; the snapshot bounds the
+        notice-propagation latency at poll_s instead."""
+        if cached and self._watchdog is not None and self._sig_cache is not None:
+            return self._sig_cache
+        out = self._fresh("sig")
+        self._sig_cache = out
+        return out
+
+    def notice_host(self) -> Optional[int]:
+        """The host whose notice landed first (earliest publish stamp;
+        stamps only break ties between hosts that BOTH received local
+        signals, so cross-host clock skew can at worst swap credit
+        between two genuinely-signaled hosts)."""
+        sigs = self.cluster_signals()
+        if not sigs:
+            return None
+        return min(sigs, key=lambda h: (sigs[h].get("ts", 0.0), h))
+
+    def exit_pending(self, cached: bool = False) -> bool:
+        """True once ANY host has published an exit ack — a peer began
+        draining the cluster (its wall clock crossed --exit_duration, it
+        completed train_iters, or it observed a signal first). Coordinated
+        training cannot continue without that peer, so the train loop
+        JOINS the exit agreement when it sees this, instead of stepping
+        until its own exit cause fires — which, on a lockstep cluster,
+        could require collective participation the draining peer has
+        already withdrawn. cached=True serves the sideband snapshot (same
+        rationale as cluster_signals)."""
+        if cached and self._watchdog is not None and self._ack_cache is not None:
+            return bool(self._ack_cache)
+        recs = self._fresh("preempt_ack")
+        self._ack_cache = recs
+        return bool(recs)
+
+    def ack_exit(self, iteration: int) -> None:
+        """Publish this host's exit ack WITHOUT waiting for the cluster —
+        the completion path uses it: a host that reached train_iters must
+        record its final position so a preemption notice published a
+        moment later still resolves every peer's exit agreement (to
+        train_iters) instead of waiting on a host that already left the
+        loop."""
+        self._put(f"preempt_ack/{self.host}", iteration=int(iteration))
+
+    def agree_exit_iteration(self, iteration: int,
+                             timeout_s: Optional[float] = None
+                             ) -> Tuple[int, Optional[int]]:
+        """All hosts ack the cluster exit with their current iteration;
+        the agreed exit/save boundary is the max (hosts behind it keep
+        stepping — deterministic data order means they converge on the
+        same state; nobody can step backwards). Returns
+        (target_iteration, notice_host). Startup-grade default deadline,
+        same rationale as commit_barrier: a slow-but-heartbeating peer
+        (mid-compile) extends the wait; a dead one ends it early with
+        evidence."""
+        timeout_s = (timeout_s if timeout_s is not None
+                     else startup_timeout_s())
+        self.ack_exit(iteration)
+        recs = self._wait_all("preempt_ack", timeout_s, "exit agreement")
+        target = max(int(r.get("iteration", iteration))
+                     for r in recs.values())
+        return target, self.notice_host()
+
+    # -- protocol 2: coordinated abort + liveness ----------------------------
+
+    def publish_abort(self, cause: str, **detail: Any) -> None:
+        """Poison record: this host is about to die deliberately (hang
+        verdict, SDC, preempt-save timeout). Peers abort instead of
+        blocking in the next collective forever."""
+        try:
+            self._put(f"abort/{self.host}", cause=str(cause),
+                      ts=time.time(), **detail)
+        except Exception as e:  # noqa: BLE001 - the local abort must
+            # proceed even when the shared medium is the thing that died
+            self.log(f"coordination: abort publish failed ({e})")
+
+    def heartbeat(self) -> None:
+        """Liveness beat — published by the sideband thread (NOT the step
+        loop: a cluster wedged in one collective stops stepping on every
+        host at once, and mutual it-stopped-stepping verdicts would abort
+        healthy runs; process-liveness only dies when the process does)."""
+        self._hb_n += 1
+        self._put(f"hb/{self.host}", n=self._hb_n)
+
+    def peer_abort(self) -> Optional[Dict[str, Any]]:
+        """The first fresh poison record from a DIFFERENT host, or None."""
+        for h, rec in sorted(self._fresh("abort").items()):
+            if h != self.host:
+                return rec
+        return None
+
+    def dead_peer(self) -> Optional[int]:
+        """A peer whose heartbeat value has not changed for
+        peer_death_timeout_s (observed with LOCAL monotonic time), or that
+        has vanished from the record set after being seen — a SIGKILL
+        leaves no poison record, only silence. None while all peers live.
+
+        A peer that has NEVER heartbeat is judged against the
+        startup-grade window instead: heartbeats start at coordinator
+        construction, so "no heartbeat yet" means the peer's process is
+        still booting (interpreter + imports), which legitimately takes
+        far longer than the steady-state death window."""
+        if self.peer_death_timeout_s <= 0:
+            return None
+        now = time.monotonic()
+        hbs = self._fresh("hb")
+        for h in range(self.num_hosts):
+            if h == self.host:
+                continue
+            rec = hbs.get(h)
+            val = json.dumps(rec, sort_keys=True) if rec is not None else ""
+            if rec is not None:
+                self._peer_seen.add(h)
+            seen = self._peer_hb.get(h)
+            if seen is None or seen[0] != val:
+                self._peer_hb[h] = (val, now)
+                continue
+            limit = (self.peer_death_timeout_s if h in self._peer_seen
+                     else max(startup_timeout_s(),
+                              self.peer_death_timeout_s))
+            if now - seen[1] >= limit:
+                return h
+        return None
+
+    def check_peers(self) -> Optional[Dict[str, Any]]:
+        """One liveness pass: a fresh peer poison record wins (it names
+        its cause); otherwise a stale/vanished heartbeat is reported as
+        cause="peer_death". None while the cluster is healthy."""
+        abort = self.peer_abort()
+        if abort is not None:
+            return abort
+        dead = self.dead_peer()
+        if dead is not None:
+            return {"host": dead, "cause": "peer_death",
+                    "detail": f"no heartbeat from host {dead} for "
+                              f"{self.peer_death_timeout_s:.1f}s"}
+        return None
+
+    # -- protocol 3: two-phase checkpoint commit -----------------------------
+
+    def commit_barrier(self, iteration: int, crc: str,
+                       timeout_s: Optional[float] = None) -> None:
+        """Phase 1+2 of the cluster checkpoint commit: publish
+        staged(iteration, crc) — meaning every byte THIS host owes the
+        checkpoint is durably on disk — then wait for all hosts' staged
+        records. Returning means the cluster agreed; raising CommitAborted
+        means the caller must NOT flip its tracker (and leaves the staging
+        dir for the next cleanup pass). Records are per-(boot, iteration),
+        so a re-save of the same iteration after a restart never matches a
+        dead incarnation's votes.
+
+        The default deadline is startup-grade ON PURPOSE: the wait ends
+        EARLY on evidence (_wait_all: peer poison record, stale peer
+        heartbeat), so the long ceiling only bounds the
+        no-evidence-either-way case — a peer that is alive and voting
+        slowly must extend the commit, never abort it.
+
+        Votes are additionally keyed by a per-iteration ATTEMPT counter:
+        a re-save of the same iteration within one incarnation (the
+        divergence-rollback path re-traverses committed iterations, and
+        _finalize has an explicit same-iteration re-save branch) must
+        wait for the peers' votes for THIS attempt, never be satisfied by
+        the previous attempt's leftovers. Hosts count attempts locally —
+        coordinated saves are iteration-deterministic and an aborted
+        commit aborts on every host, so the counters stay aligned."""
+        timeout_s = (timeout_s if timeout_s is not None
+                     else startup_timeout_s())
+        it = int(iteration)
+        attempt = self._commit_attempts.get(it, 0)
+        self._commit_attempts[it] = attempt + 1
+        self._put(f"commit/{it}/{attempt}/{self.host}",
+                  iteration=it, crc=str(crc), attempt=attempt)
+        try:
+            self._wait_all(f"commit/{it}/{attempt}", timeout_s,
+                           f"checkpoint commit @ iteration {it} "
+                           f"(attempt {attempt})")
+        except CoordinationError as e:
+            raise CommitAborted(str(e)) from e
+
+    def agree_resume_iteration(self, valid: Sequence[int],
+                               timeout_s: Optional[float] = None
+                               ) -> Optional[int]:
+        """Resume-side inverse of the commit barrier: each host publishes
+        the checkpoint iterations IT holds valid; the agreed resume point
+        is the newest iteration valid on EVERY host (None when the
+        intersection is empty — fresh start everywhere). A host whose
+        tracker ran ahead (killed peers never flipped theirs) is pulled
+        back to the cluster-consistent choice here."""
+        timeout_s = timeout_s if timeout_s is not None else startup_timeout_s()
+        self._put(f"resume/{self.host}", valid=sorted(int(v) for v in valid))
+        recs = self._wait_all("resume", timeout_s, "resume agreement")
+        common = None
+        for rec in recs.values():
+            have = set(int(v) for v in rec.get("valid", ()))
+            common = have if common is None else (common & have)
+        if not common:
+            return None
+        return max(common)
+
+    # -- host->host data ----------------------------------------------------
+
+    def broadcast(self, obj: Any, root: int = 0, key: str = "bcast",
+                  timeout_s: Optional[float] = None) -> Any:
+        """Broadcast one JSON-able host value from `root` to every host —
+        the host-data half of multihost broadcast, over the agreement
+        medium instead of an XLA collective (which this CPU backend cannot
+        run; tests/test_multihost.py). ONE-SHOT per key per incarnation:
+        a reused key hands late readers whichever value is newest with no
+        generation marker — give each call site its own key."""
+        timeout_s = (timeout_s if timeout_s is not None
+                     else max(self.peer_death_timeout_s, 10.0))
+        if self.host == root:
+            self._put(f"{key}/{root}", value=obj)
+            return obj
+        deadline = time.monotonic() + timeout_s
+        while True:
+            recs = self._fresh(key)
+            if root in recs:
+                return recs[root].get("value")
+            if time.monotonic() >= deadline:
+                raise CoordinationError(
+                    f"broadcast '{key}': nothing from host {root} after "
+                    f"{timeout_s:.1f}s")
+            time.sleep(self.poll_s)
+
+    def publish_value(self, key: str, value: Any) -> None:
+        """Non-blocking single-writer record (e.g. host 0's agreed save
+        cadence): peers read the latest with read_value()."""
+        self._put(f"{key}/{self.host}", value=value)
+
+    def read_value(self, key: str, host: int = 0) -> Optional[Any]:
+        rec = self._fresh(key).get(host)
+        return None if rec is None else rec.get("value")
+
+    # -- sideband watchdog ---------------------------------------------------
+
+    def start_heartbeats(self) -> None:
+        """Start the publish-only sideband (one immediate heartbeat, then
+        one per poll_s) — for_training calls this at construction so the
+        startup barriers' evidence-based waits can judge THIS host alive
+        long before the train loop finishes building its model (the gap
+        between the topology barrier and the first step can exceed any
+        steady-state death window on a large model). The peer-verdict
+        callback is armed later via start_watchdog."""
+        self.heartbeat()
+        if self._watchdog is None:
+            self._watchdog = _SidebandWatchdog(self, on_peer_abort=None)
+            self._watchdog.start()
+
+    def sideband_armed(self) -> bool:
+        """True while the sideband thread is running WITH a peer-verdict
+        callback — the train loop skips its inline per-step liveness poll
+        then (the sideband covers it at poll_s cadence, collectives
+        included)."""
+        wd = self._watchdog
+        return (wd is not None and wd.on_peer_abort is not None
+                and not wd.fired)
+
+    def start_watchdog(self, on_peer_abort: Callable[[Dict[str, Any]], None]
+                       ) -> "_SidebandWatchdog":
+        """Arm the peer-verdict callback on the sideband thread (which has
+        been publishing heartbeats since construction): from here on a
+        peer's poison record or death is acted on even while this host is
+        blocked inside a collective (where the between-steps poll never
+        runs). The callback runs on the sideband thread and is expected
+        not to return (the train loop's handler journals `peer_abort` and
+        os._exits)."""
+        if self._watchdog is None:
+            self._watchdog = _SidebandWatchdog(self, on_peer_abort)
+            self._watchdog.start()
+        else:
+            self._watchdog.on_peer_abort = on_peer_abort
+        return self._watchdog
+
+    def stop_watchdog(self) -> None:
+        """Disarm verdicts AND stop heartbeating — callers do this only on
+        the way out (train() teardown), where going heartbeat-silent is
+        the honest signal."""
+        if self._watchdog is not None:
+            self._watchdog.stop()
+            self._watchdog = None
+
+    def close(self) -> None:
+        self.stop_watchdog()
+
+
+class _SidebandWatchdog:
+    """Daemon thread: heartbeat publishing plus — once `on_peer_abort` is
+    armed — peer-death/abort polling; bounded work per tick (two reads +
+    one write against the backend)."""
+
+    def __init__(self, coord: ClusterCoordinator,
+                 on_peer_abort: Optional[Callable[[Dict[str, Any]], None]]):
+        self.coord = coord
+        self.on_peer_abort = on_peer_abort
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.fired = False
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="coord-sideband", daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=self.coord.poll_s * 4 + 5)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.coord.poll_s):
+            try:
+                self.coord.heartbeat()
+                # refresh the snapshots the train loop reads
+                # (cluster_signals/exit_pending cached=True) every tick
+                self.coord.cluster_signals()
+                self.coord.exit_pending()
+                cb = self.on_peer_abort
+                verdict = self.coord.check_peers() if cb else None
+            except Exception as e:  # noqa: BLE001 - a flaky shared medium
+                # must not kill liveness; next tick retries (persistent
+                # failure surfaces as peers declaring US dead)
+                self.coord.log(f"coordination sideband: poll failed ({e})")
+                continue
+            if verdict is not None:
+                self._stop.set()
+                self.fired = True
+                cb(verdict)
+                return
+
+
+# ---------------------------------------------------------------------------
+# construction
+# ---------------------------------------------------------------------------
+
+
+def startup_timeout_s() -> float:
+    try:
+        return float(os.environ.get(STARTUP_TIMEOUT_ENV, "") or
+                     DEFAULT_STARTUP_TIMEOUT_S)
+    except ValueError:
+        return DEFAULT_STARTUP_TIMEOUT_S
+
+
+def resolve_host_identity() -> Tuple[int, int]:
+    """(host, num_hosts): env overrides (the file-backend story, where
+    'hosts' may be plain processes that never touch jax.distributed),
+    else the jax process topology."""
+    env_host = os.environ.get(COORD_HOST_ENV)
+    env_n = os.environ.get(COORD_NUM_HOSTS_ENV)
+    if env_host is not None or env_n is not None:
+        if env_host is None or env_n is None:
+            raise ValueError(
+                f"{COORD_HOST_ENV} and {COORD_NUM_HOSTS_ENV} must be set "
+                "together")
+        return int(env_host), int(env_n)
+    import jax
+
+    return jax.process_index(), jax.process_count()
+
+
+def for_training(tcfg, log: Callable[[str], None] = print
+                 ) -> Optional[ClusterCoordinator]:
+    """The coordinator a TrainingConfig implies, or None (single-host).
+
+    Backend selection: `--coordination_dir` forces the file backend
+    (works without jax.distributed); otherwise `jax.process_count() > 1`
+    selects the KV backend on the live coordination service. num_hosts==1
+    — however reached — means NO coordinator: the single-process paths
+    stay byte-identical.
+    """
+    host, num_hosts = resolve_host_identity()
+    if num_hosts < 2:
+        return None
+    coord_dir = getattr(tcfg, "coordination_dir", None)
+    if coord_dir:
+        backend = FileBackend(coord_dir)
+    else:
+        import jax
+
+        if jax.process_count() < 2:
+            raise ValueError(
+                f"{COORD_NUM_HOSTS_ENV}={num_hosts} but jax.distributed is "
+                "not initialized and no --coordination_dir is set — the KV "
+                "backend needs the coordination service, the file backend "
+                "needs a shared directory")
+        backend = KVBackend()
+    coord = ClusterCoordinator(
+        backend, host, num_hosts,
+        peer_death_timeout_s=getattr(tcfg, "peer_death_timeout_s", 60.0),
+        log=log)
+    coord.start_heartbeats()
+    log(f"coordination: host {host}/{num_hosts} on the {backend.name} "
+        f"backend (peer_death_timeout_s="
+        f"{coord.peer_death_timeout_s:g})")
+    return coord

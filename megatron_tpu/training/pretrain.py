@@ -39,7 +39,9 @@ from megatron_tpu.parallel.mesh import MeshRuntime, build_mesh
 from megatron_tpu.parallel.sharding import (
     activation_spec, batch_spec, constrain, shard_tree, tree_shardings,
 )
-from megatron_tpu.training import checkpointing, prefetch, resilience
+from megatron_tpu.training import (
+    checkpointing, coordination, prefetch, resilience,
+)
 from megatron_tpu.training.microbatches import MicroBatchCalculator
 from megatron_tpu.training.optimizer import (
     TrainState, init_train_state, train_state_specs,
@@ -148,6 +150,29 @@ class TrainLoop:
             except Exception as e:  # noqa: BLE001 - cache is best-effort
                 self.log(f"compilation cache unavailable ({e}); "
                          "continuing without")
+        # multi-host coordination (training/coordination.py): the
+        # agreement seam for signals/aborts/commits/restarts — None on
+        # single-process runs, where every downstream path is untouched.
+        # The restart barrier runs BEFORE any mesh work so a topology
+        # disagreement (host count changed under the run) is a loud,
+        # journaled error here instead of a coordinator timeout inside
+        # jax.distributed or the first collective.
+        self.coord = coordination.for_training(run_cfg.training, log=self.log)
+        if self.coord is not None:
+            if run_cfg.training.save_interval_auto:
+                # per-host MEASURED latencies differ, and hosts that are
+                # not in iteration-lockstep cannot agree on exact future
+                # save iterations without a blocking rendezvous — an
+                # un-agreed cadence would desynchronize the two-phase
+                # commit votes. Refuse loudly; a fixed interval is
+                # deterministic by arithmetic on every host.
+                self.coord.close()  # stop the heartbeat sideband first
+                raise ValueError(
+                    "--save_interval auto is not supported on coordinated "
+                    "multi-host runs yet (the autotuned cadence is per-"
+                    "host-measured and would desynchronize the two-phase "
+                    "checkpoint commit); use a fixed --save_interval")
+            self.coord.topology_barrier()
         if jax.process_count() > 1:
             # multi-host: DCN-aware mesh (data axis outermost across slices)
             from megatron_tpu.parallel.distributed import build_multihost_mesh
@@ -213,6 +238,10 @@ class TrainLoop:
         # its own topology to detect an elastic dp change (_load)
         self._save_config = run_cfg.to_dict()
         self._save_config["parallel"]["data_parallel"] = self.rt.dp
+        # the HOST topology rides in the checkpoint too, so a resume at a
+        # different host count is detected the same way a dp change is
+        self._save_config["coordination"] = {
+            "num_hosts": self.coord.num_hosts if self.coord else 1}
         self._elastic_resume: Optional[Dict[str, Any]] = None
 
         if run_cfg.training.load:
@@ -262,6 +291,35 @@ class TrainLoop:
         self._exit_signal: Optional[str] = None
         self._watchdog: Optional[resilience.StepWatchdog] = None
         self._batch_fps: Dict[int, str] = {}
+        # multi-host exit agreement cache: (target_iteration, notice_host)
+        # once the cluster has agreed where to drain+save, else None
+        self._exit_agreement: Optional[Tuple[int, Optional[int]]] = None
+        self._notice_host: Optional[int] = None
+        # set when the exit agreement proved unreachable: the final save
+        # must commit SOLO (coordinator dropped) or its two-phase barrier
+        # would wait on the same unreachable peers forever
+        self._commit_solo = False
+
+        # --save_interval auto (resilience.CheckpointCadenceTuner): the
+        # cadence is re-derived from measured commit latency; seeded from
+        # the journal of previous incarnations so a restart's FIRST
+        # interval is already informed
+        self._cadence: Optional[resilience.CheckpointCadenceTuner] = None
+        self._cadence_commit_seen: Optional[float] = None
+        self._last_save_iter = self.iteration
+        if t.save_interval_auto:
+            self._cadence = resilience.CheckpointCadenceTuner(
+                grace_s=t.preempt_save_timeout,
+                floor_steps=t.save_interval_floor)
+            if t.telemetry_dir:
+                from megatron_tpu.telemetry.journal import read_events
+
+                path = os.path.join(t.telemetry_dir, "events.jsonl")
+                if os.path.exists(path):
+                    n = self._cadence.seed_from_journal(read_events(path)[0])
+                    if n:
+                        self.log(f"save cadence: seeded from {n} journaled "
+                                 "commit-latency samples")
 
         sp = run_cfg.parallel.sequence_parallel
 
@@ -313,11 +371,27 @@ class TrainLoop:
                 model_flops_per_token_fwd=model_cfg.flops_per_token_fwd(),
                 async_loop=t.async_loop, prefetch_depth=t.prefetch_depth,
                 metrics_lag=t.metrics_lag,
-                compilation_cache_dir=t.compilation_cache_dir)
+                compilation_cache_dir=t.compilation_cache_dir,
+                # host identity on the run record: every later event in
+                # this journal is attributable to one host of the
+                # cluster (tools/telemetry_report.py merges per-host
+                # journals off exactly this field)
+                **({"host": self.coord.host,
+                    "num_hosts": self.coord.num_hosts}
+                   if self.coord is not None else {}))
             if self._elastic_resume is not None:
                 # the topology changed under the run (detected in _load,
                 # journaled here because telemetry outlives _load)
                 self.telemetry.emit("elastic_resume", **self._elastic_resume)
+
+        if self.coord is not None:
+            # sideband liveness: heartbeats + peer abort/death polling on
+            # a bounded daemon thread, so even a host wedged inside a
+            # collective observes a peer's poison record and exits
+            # PEER_ABORT_EXIT_CODE instead of waiting for the scheduler.
+            # Started after telemetry so the verdict can be journaled;
+            # stopped in train()'s finally after the last commit flushed.
+            self.coord.start_watchdog(self._on_peer_abort)
 
     # -- placed (interleaved) layer order -----------------------------------
 
@@ -345,9 +419,31 @@ class TrainLoop:
 
     def _load(self):
         t = self.cfg.training
+        pinned = None
+        if self.coord is not None:
+            # cluster-consistent resume: every host publishes the
+            # checkpoint iterations IT holds valid (per-host manifests
+            # verified by list_valid_checkpoints) and the cluster loads
+            # the newest one valid EVERYWHERE — a host whose tracker ran
+            # ahead of a two-phase commit its peers never finished is
+            # pulled back here instead of resuming a torn cluster state
+            valid = checkpointing.list_valid_checkpoints(t.load)
+            pinned = self.coord.agree_resume_iteration(valid)
+            if pinned is None:
+                self.log(
+                    "coordination: no checkpoint is valid on every host "
+                    f"(local valid: {valid}); all hosts start fresh")
+                return
+            local = checkpointing.read_tracker(t.load)
+            if local != pinned:
+                self.log(
+                    f"coordination: local tracker points at {local} but "
+                    f"the cluster-consistent checkpoint is {pinned} — "
+                    "loading the agreed iteration")
         try:
             state, it, consumed = checkpointing.load_checkpoint(
                 t.load, self.state, shardings=self.state_shardings,
+                iteration=pinned,
                 finetune=t.finetune, no_load_optim=t.no_load_optim,
                 config=self._save_config)
         except FileNotFoundError:
@@ -373,9 +469,20 @@ class TrainLoop:
         except (OSError, ValueError, FileNotFoundError):
             return  # pre-config checkpoint: nothing to compare
         saved_t = saved.get("training") or {}
-        saved_dp = (saved.get("parallel") or {}).get("data_parallel")
+        saved_par = saved.get("parallel") or {}
+        saved_dp = saved_par.get("data_parallel")
         saved_mb = saved_t.get("micro_batch_size", t.micro_batch_size)
         saved_gbs = saved_t.get("global_batch_size", t.global_batch_size)
+        # model-parallel and host-topology changes ride the same
+        # detection: the checkpoint layer is topology-free (orbax
+        # reshards on load), so tp/pp/host-count changes are legal — but
+        # they must be VISIBLE (journaled elastic_resume), never silent
+        saved_tp = int(saved_par.get("tensor_parallel") or self.rt.tp)
+        saved_pp = int(saved_par.get("pipeline_parallel") or self.rt.pp)
+        saved_cp = int(saved_par.get("context_parallel") or self.rt.cp)
+        saved_hosts = int((saved.get("coordination") or {}).get(
+            "num_hosts") or 0)
+        cur_hosts = self.coord.num_hosts if self.coord else 1
         if not saved_dp:
             return
         saved_dp, saved_mb = int(saved_dp), int(saved_mb)
@@ -396,7 +503,11 @@ class TrainLoop:
                 "only makes sense as a deliberate schedule change")
         changed_dp = saved_dp != self.rt.dp
         changed_mb = saved_mb != t.micro_batch_size
-        if not (changed_dp or changed_mb or saved_gbs != gbs):
+        changed_mp = (saved_tp != self.rt.tp or saved_pp != self.rt.pp
+                      or saved_cp != self.rt.cp)
+        changed_hosts = bool(saved_hosts) and saved_hosts != cur_hosts
+        if not (changed_dp or changed_mb or changed_mp or changed_hosts
+                or saved_gbs != gbs):
             return
         accum_from = saved_gbs // max(saved_mb * saved_dp, 1)
         accum_to = gbs // (t.micro_batch_size * self.rt.dp)
@@ -408,7 +519,17 @@ class TrainLoop:
             "from_global_batch": saved_gbs,
             "global_batch_size": gbs,
             "accum_from": accum_from, "accum_to": accum_to,
+            "from_tp": saved_tp, "to_tp": self.rt.tp,
+            "from_pp": saved_pp, "to_pp": self.rt.pp,
+            "from_hosts": saved_hosts or cur_hosts, "to_hosts": cur_hosts,
         }
+        mp_note = ""
+        if changed_mp:
+            mp_note = (f"; model parallelism tp {saved_tp}->{self.rt.tp} "
+                       f"pp {saved_pp}->{self.rt.pp} (orbax reshard on "
+                       "load; sample order unaffected)")
+        if changed_hosts:
+            mp_note += f"; hosts {saved_hosts}->{cur_hosts}"
         self.log(
             f"elastic resume: checkpoint written at data_parallel="
             f"{saved_dp} x micro_batch={saved_mb} (accumulation "
@@ -417,7 +538,8 @@ class TrainLoop:
             + (f"— WARNING: global batch changed {saved_gbs} -> {gbs}"
                if saved_gbs != gbs else
                f"— global batch {gbs}, sample order, and "
-               f"consumed_samples={self.consumed_samples} are unchanged"))
+               f"consumed_samples={self.consumed_samples} are unchanged")
+            + mp_note)
 
     def save(self, tags: Tuple[str, ...] = ()):
         t = self.cfg.training
@@ -434,9 +556,19 @@ class TrainLoop:
             self._saver = checkpointing.AsyncCheckpointSaver(
                 t.save, keep_latest_k=t.keep_latest_k, log=self.log,
                 async_save=t.async_save,
-                journal=(self.telemetry.journal if self.telemetry else None))
+                # journal_sink: commit events also feed the /metrics
+                # event counters (train_commit_aborts_total)
+                journal=(self.telemetry.journal_sink()
+                         if self.telemetry else None))
+        # per-save coordinator (the ONE wiring point): coordinated
+        # two-phase commit normally; dropped on a solo drain (exit
+        # agreement unreachable) so the commit doesn't wait on the peers
+        # the agreement already proved unreachable — resume's valid-set
+        # intersection keeps the cluster consistent around a solo commit
+        self._saver.coordinator = None if self._commit_solo else self.coord
         self._saver.save(state, self.iteration, self.consumed_samples,
                          config=self._save_config, tags=tags)
+        self._last_save_iter = self.iteration
         self.timers("save-checkpoint", 0).stop()
         if self.telemetry is not None:
             # the span above is the train-loop STALL (async: barrier +
@@ -451,9 +583,38 @@ class TrainLoop:
         if self._saver is not None:
             self._saver.wait()
 
+    def _cadence_due(self) -> bool:
+        """--save_interval auto: is a checkpoint due this iteration?
+        Feeds the tuner any newly observed commit latency and journals
+        `cadence_retune` when the derived interval moves."""
+        t = self.cfg.training
+        if not t.save:
+            return False
+        if (self._saver is not None
+                and self._saver.last_commit_seconds is not None
+                and self._saver.last_commit_seconds
+                != self._cadence_commit_seen):
+            self._cadence_commit_seen = self._saver.last_commit_seconds
+            self._cadence.note_commit(self._cadence_commit_seen)
+        retune = self._cadence.retune()
+        if retune is not None:
+            self.log(
+                f"save cadence: interval {retune['from_interval']} -> "
+                f"{retune['to_interval']} steps (grace "
+                f"{retune['grace_s']:g}s - p95 commit "
+                f"{retune['p95_commit_ms']:g}ms over p50 step "
+                f"{retune['p50_step_ms']:g}ms, floor {retune['floor']})")
+            if self.telemetry is not None:
+                self.telemetry.emit("cadence_retune", iteration=self.iteration,
+                                    **retune)
+        interval = self._cadence.interval()
+        if not interval:
+            return False
+        return (self.iteration - self._last_save_iter) >= interval
+
     # -- preemption / hang / SDC sentinels -----------------------------------
 
-    def _preempt_save(self, sig) -> None:
+    def _preempt_save(self, sig, already_saved: bool = False) -> None:
         """Expedited preemption path: the first SIGTERM already drained
         the metrics pipeline (caller); here the loop forces a SYNCHRONOUS
         committed checkpoint — bypassing --save_interval, tagged
@@ -463,7 +624,17 @@ class TrainLoop:
         deadline force-exits PREEMPT_TIMEOUT_EXIT_CODE: overstaying a
         preemption notice means the scheduler's SIGKILL lands mid-write
         anyway, so dying deliberately with the journal flushed is
-        strictly better evidence."""
+        strictly better evidence.
+
+        already_saved: the loop's periodic save this same pass already
+        checkpointed exactly this iteration (save-interval arithmetic is
+        identical on every host, so the skip is cluster-symmetric): only
+        flush that commit durable instead of writing the state a second
+        time — a duplicate full write could spend the remaining grace
+        window for nothing (and, coordinated, would open a second commit
+        attempt a completer that already exited can never vote in). The
+        tracker points at the periodic checkpoint, so retention keeps it
+        even without the `preemption` tag."""
         t = self.cfg.training
         self._stop_watchdog()  # the preempt deadline takes over
         first = sig.first_signal()
@@ -515,6 +686,11 @@ class TrainLoop:
                 jt.join(timeout=5.0)
                 if committed.is_set():
                     return
+                if self.coord is not None:
+                    # poison record: peers must not wait for a commit
+                    # vote this host will never cast
+                    self.coord.publish_abort(
+                        "preempt_timeout", iteration=self.iteration)
                 os._exit(resilience.PREEMPT_TIMEOUT_EXIT_CODE)
 
             timer = threading.Timer(budget, _overdue)
@@ -522,7 +698,8 @@ class TrainLoop:
             timer.start()
         try:
             t0 = time.monotonic()
-            self.save(tags=("preemption",))
+            if not already_saved:
+                self.save(tags=("preemption",))
             self._flush_saves()  # commit NOW — the exit must find it durable
             t1 = time.monotonic()
         finally:
@@ -537,13 +714,21 @@ class TrainLoop:
                  + ("" if t.save else "; no --save dir: nothing written")
                  + ")")
         if self.telemetry is not None:
+            extra = {}
+            if self.coord is not None:
+                # which host the cluster's notice landed on (the signal
+                # agreement protocol carried it here) + who is reporting
+                extra = {"notice_host": self._notice_host,
+                         "host": self.coord.host}
+            if already_saved:
+                extra["pre_saved"] = True  # periodic save covered it
             self.telemetry.emit(
                 "preemption", iteration=self.iteration,
                 signal="SIGTERM", consumed_samples=self.consumed_samples,
                 save_latency_ms=round(save_ms, 1),
                 notice_to_commit_ms=round(notice_ms, 1),
                 save_timeout_s=t.preempt_save_timeout,
-                saved=bool(t.save))
+                saved=bool(t.save), **extra)
 
     def _heartbeat(self, note: str) -> None:
         """Progress beat shared by the flight recorder and the step
@@ -610,7 +795,37 @@ class TrainLoop:
                     self.telemetry.journal.flush()
                 except OSError:
                     pass
+        if self.coord is not None:
+            # poison record BEFORE dying: peers abort with a journaled
+            # peer_abort{host, cause:"hang"} instead of wedging in the
+            # collective this host just abandoned
+            self.coord.publish_abort("hang", iteration=stuck_at,
+                                     heartbeat_age_s=round(age, 1))
         os._exit(resilience.HANG_EXIT_CODE)
+
+    def _on_peer_abort(self, verdict: Dict[str, Any]) -> None:
+        """A peer died (poison record, or heartbeat silence past
+        --peer_death_timeout_s): journal `peer_abort{host, cause}`, flush,
+        and exit PEER_ABORT_EXIT_CODE — a deliberate, attributable abort
+        instead of hanging in the next collective until the scheduler's
+        timeout kill. Runs on the sideband thread or inline from the
+        between-steps poll."""
+        host, cause = verdict.get("host"), verdict.get("cause")
+        self.log(f"peer abort: host {host} ({cause}) — exiting "
+                 f"{resilience.PEER_ABORT_EXIT_CODE} "
+                 f"({verdict.get('detail', '')})")
+        if self.telemetry is not None:
+            self.telemetry.emit(
+                "peer_abort", host=host, cause=cause,
+                detail=verdict.get("detail"),
+                iteration=self.iteration,
+                observed_by=(self.coord.host if self.coord else None))
+            if self.telemetry.journal is not None:
+                try:
+                    self.telemetry.journal.flush()
+                except OSError:
+                    pass
+        os._exit(resilience.PEER_ABORT_EXIT_CODE)
 
     def _note_fingerprint(self, batch: Dict[str, np.ndarray],
                           iteration: int) -> Dict[str, np.ndarray]:
@@ -1016,11 +1231,32 @@ class TrainLoop:
         iterator of global batches at that batch size (rampup-aware)."""
         try:
             return self._train_inner(train_iter_factory, valid_iter_factory)
+        except BaseException as e:  # noqa: BLE001 - re-raised below; the
+            # catch exists ONLY to publish the cluster poison record so
+            # peers stop cleanly instead of wedging in a collective
+            if self.coord is not None:
+                # any abnormal exit is a poison record: peers must stop
+                # cleanly (PEER_ABORT_EXIT_CODE) rather than block in the
+                # next collective on a host that is unwinding its stack —
+                # this covers DivergenceError/SDCError aborts and plain
+                # crashes alike (the hang/preempt-timeout paths publish
+                # their own cause before os._exit)
+                self.coord.publish_abort(
+                    type(e).__name__, iteration=self.iteration,
+                    detail=str(e)[:300])
+            raise
         finally:
             # forced flush: every exit path (normal return, SIGTERM,
             # exception) barriers on the in-flight async checkpoint write
             # so a committed tracker is what the next resume finds
-            self._flush_saves()
+            try:
+                self._flush_saves()
+            finally:
+                if self.coord is not None:
+                    # after the flush: the commit barrier needs the
+                    # sideband alive to turn a peer death during the
+                    # final commit into a clean exit
+                    self.coord.stop_watchdog()
             if self.telemetry is not None:
                 # after the flush so the last checkpoint_commit event is
                 # in the journal before the final goodput line; run_end
@@ -1072,6 +1308,8 @@ class TrainLoop:
         else:
             # lag 0: the fetch already happened inside the span
             step_s = rec["dispatch_s"]
+        if self._cadence is not None:
+            self._cadence.note_step(step_s)
         loss_host = float(host["loss"])
         self._last_host_metrics = host
         ntok = rec["ntok"]
@@ -1265,6 +1503,20 @@ class TrainLoop:
                     if drain(0):
                         on_rollback()
                         continue
+                    if (self.coord is not None
+                            and self._exit_agreement is None):
+                        # completion publishes a NON-BLOCKING exit ack at
+                        # train_iters: a preemption notice racing normal
+                        # completion — even one published a pass after
+                        # this check — resolves every peer's exit
+                        # agreement to train_iters, so drainers catch up
+                        # and every host's two-phase commit votes at ONE
+                        # iteration (without this, a completer's final
+                        # save and a drainer's preempt save would
+                        # deadlock at different commit barriers, or the
+                        # drainer's agreement would wait on a host that
+                        # already left the loop)
+                        self.coord.ack_exit(self.iteration)
                     break
                 gbs = self.calc.global_batch(self.consumed_samples)
                 if gbs != current_gbs or data_iter is None:
@@ -1327,6 +1579,14 @@ class TrainLoop:
                     # records it; the expedited save path below runs
                     # after this iteration completes)
                     resilience.maybe_signal("preempt_at", self.iteration + 1)
+                    # multi-host forms: the fault hits exactly ONE host
+                    # of the cluster (kill_host:HOST:ITER /
+                    # preempt_host:HOST:ITER); host 0 when uncoordinated
+                    fault_host = self.coord.host if self.coord else 0
+                    resilience.maybe_kill_host(fault_host,
+                                               self.iteration + 1)
+                    resilience.maybe_signal_host(fault_host,
+                                                 self.iteration + 1)
                     # a wedged collective/device step: only the
                     # --step_timeout_s watchdog turns this into a flight
                     # bundle + clean abort
@@ -1427,46 +1687,173 @@ class TrainLoop:
                         self.writer.add_scalar(f"valid/{k}", v, self.iteration)
                     self.writer.flush()
 
+                # periodic save FIRST — before anything that can block on
+                # the cluster exit agreement. Periodic save iterations
+                # are identical on every host by interval arithmetic, and
+                # their two-phase votes are cast from here (the finalizer
+                # thread), so a peer blocked in the exit agreement never
+                # holds up a commit barrier: without this ordering, host
+                # A can wedge in save().wait() on a commit that needs
+                # B's vote while B wedges in the agreement that needs
+                # A's ack — a distributed deadlock cycle (observed live).
+                if self._cadence is not None:
+                    saved_now = self._cadence_due()
+                else:
+                    saved_now = bool(
+                        t.save_interval
+                        and self.iteration % t.save_interval == 0)
+                if saved_now:
+                    if (self.coord is not None
+                            and self._exit_agreement is None):
+                        # about to block on the PREVIOUS save's commit
+                        # barrier (saver.save waits on it): if a cluster
+                        # drain is pending, publish our non-blocking exit
+                        # ack FIRST — the peers' agreement resolves on
+                        # it, they catch up through every periodic save
+                        # iteration, and the barrier's missing votes get
+                        # cast. Without this, a host that raced past the
+                        # notice (snapshot staleness is ~poll_s ≈ many
+                        # steps) wedges in the save wait before ever
+                        # acking, while peers wedge in the agreement
+                        # waiting for that ack (observed live). Uncached
+                        # reads: once per save interval, not per step.
+                        self.coord.cluster_signals()
+                        if (self.coord.exit_pending()
+                                or self.coord.cluster_signals(cached=True)):
+                            self.coord.ack_exit(self.iteration)
+                    # never checkpoint past un-judged metrics: a sentinel
+                    # trip still in the pipeline CANCELS the save
+                    if drain(0):
+                        on_rollback()
+                        continue
+                    self.save()
+                    self._heartbeat(f"iteration {self.iteration} (post-save)")
+
                 should_exit = False
                 preempting = False
                 received = sig.signals_received()
-                if received:
-                    names = ",".join(
-                        signal_module.Signals(s).name for s in received)
-                    self._exit_signal = names
+                local_names = [signal_module.Signals(s).name
+                               for s in received]
+                cluster_names: set = set()
+                if self.coord is not None:
+                    # between-steps liveness poll, only when the armed
+                    # sideband is NOT covering it (it normally is, at
+                    # poll_s cadence, including inside collectives): a
+                    # duplicate inline poll would re-pay the backend
+                    # round-trips on every step for no added coverage
+                    if not self.coord.sideband_armed():
+                        verdict = self.coord.check_peers()
+                        if verdict is not None:
+                            self._on_peer_abort(verdict)
+                    # signal agreement: publish what OUR handler saw,
+                    # read the cluster-wide union — one host's SIGTERM
+                    # drains ALL hosts
+                    if received:
+                        self.coord.publish_signals(local_names)
+                    # sideband-maintained snapshot: no backend round-trip
+                    # on the hot loop; propagation bounded by poll_s
+                    cluster_names = {
+                        n for r in self.coord.cluster_signals(
+                            cached=True).values()
+                        for n in r.get("signals", ())}
+                names = sorted(set(local_names) | cluster_names)
+                if names:
+                    names_str = ",".join(names)
+                    self._exit_signal = names_str
                     # SIGTERM is a cluster preemption NOTICE: take the
                     # expedited path (drain, forced SYNCHRONOUS committed
                     # save bypassing --save_interval, bounded by
                     # --preempt_save_timeout, journaled `preemption`).
                     # SIGINT (operator Ctrl-C) keeps the ordinary
                     # checkpoint-and-exit; run_end records which arrived.
-                    preempting = signal_module.SIGTERM in received
-                    self.log(f"received {names}, checkpointing and exiting"
-                             + (" (preemption notice: expedited "
-                                "synchronous save)" if preempting else ""))
+                    preempting = "SIGTERM" in names
                     should_exit = True
                 if t.exit_interval and self.iteration % t.exit_interval == 0:
                     should_exit = True
                 if t.exit_duration_in_mins and (
                         (time.time() - start_time) / 60 > t.exit_duration_in_mins):
                     should_exit = True
+                if (not should_exit and self.coord is not None
+                        and self._exit_agreement is None
+                        and self.coord.exit_pending(cached=True)):
+                    # a PEER began draining (its --exit_duration clock
+                    # crossed, or it completed train_iters): coordinated
+                    # training cannot continue without it — join the exit
+                    # instead of stepping until our own cause fires,
+                    # which on a lockstep cluster could need collective
+                    # participation the peer has already withdrawn
+                    should_exit = True
+                if should_exit and self.coord is not None:
+                    # agree WHERE the cluster drains — for EVERY exit
+                    # cause: signals propagate with a pass of skew, and
+                    # --exit_duration_in_mins crosses at per-host wall
+                    # clocks, so hosts may decide to exit at different
+                    # iterations; everyone steps to the max acked
+                    # iteration so the final two-phase commit votes at
+                    # ONE cluster-consistent state (--exit_interval is
+                    # iteration-deterministic but riding the same path
+                    # costs nothing)
+                    if self._exit_agreement is None:
+                        try:
+                            # generous window (startup-grade): a peer
+                            # mid-compile acks at its first completed
+                            # pass, a duration-exit peer acks when its
+                            # own clock crosses, and a DEAD peer doesn't
+                            # stall this wait — the peer-death watchdog
+                            # exits out of it
+                            self._exit_agreement = \
+                                self.coord.agree_exit_iteration(
+                                    self.iteration,
+                                    timeout_s=coordination
+                                    .startup_timeout_s())
+                        except coordination.CoordinationError as e:
+                            # agreement is unreachable (peer wedged but
+                            # heartbeat-fresh, medium trouble): commit a
+                            # SOLO checkpoint — this host's save must
+                            # drop the coordinator or its commit barrier
+                            # would wait on the same unreachable peers;
+                            # resume's valid-set intersection keeps the
+                            # cluster consistent around a solo commit
+                            self.log(f"coordination: exit agreement "
+                                     f"failed ({e}); draining solo "
+                                     "(uncoordinated final commit)")
+                            self._exit_agreement = (self.iteration, None)
+                            self._commit_solo = True
+                        target, nh = self._exit_agreement
+                        self._notice_host = nh
+                        self.log(
+                            f"coordination: cluster exit agreed at "
+                            f"iteration {target} (notice on host "
+                            f"{nh}, this is host {self.coord.host})")
+                    if self.iteration < self._exit_agreement[0]:
+                        # behind the agreed boundary: keep stepping —
+                        # deterministic data order converges every
+                        # host on the same state at `target`
+                        should_exit = False
+                        preempting = False
+                if should_exit and names:
+                    self.log(
+                        f"received {names_str}, checkpointing and "
+                        "exiting"
+                        + (" (preemption notice: expedited "
+                           "synchronous save)" if preempting else ""))
 
-                saved_now = bool(
-                    t.save_interval and self.iteration % t.save_interval == 0)
-                if saved_now or should_exit:
-                    # never checkpoint past un-judged metrics: drain so a
-                    # sentinel trip still in the pipeline CANCELS the save
-                    # (this closes the lag-widened window where a diverged
-                    # state could be committed and then rolled back onto)
+                if should_exit:
+                    # drain so a sentinel trip still in the pipeline
+                    # CANCELS the exit save (this closes the lag-widened
+                    # window where a diverged state could be committed
+                    # and then rolled back onto)
                     if drain(0):
                         on_rollback()
                         continue
                     if preempting:
-                        self._preempt_save(sig)
-                    else:
+                        self._preempt_save(sig, already_saved=saved_now)
+                    elif not saved_now:
+                        # ordinary exit (SIGINT / exit_interval /
+                        # exit_duration): checkpoint unless the periodic
+                        # save above already covered this iteration
                         self.save()
                     self._heartbeat(f"iteration {self.iteration} (post-save)")
-                if should_exit:
                     return self.state
                 last_saved = self.iteration if saved_now else None
 

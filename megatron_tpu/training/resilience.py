@@ -46,6 +46,14 @@ provides
       slow_save:MS            sleep MS milliseconds inside checkpoint
                               finalization — widens the async-save commit
                               window for deterministic overlap tests
+      kill_host:HOST:ITER     multi-host form of kill_at: SIGKILL only the
+                              process whose coordination host id is HOST,
+                              right before iteration ITER — a single host
+                              dying under its peers (the survivors must
+                              exit PEER_ABORT_EXIT_CODE, not hang)
+      preempt_host:HOST:ITER  multi-host form of preempt_at: the SIGTERM
+                              notice lands on ONE host; the signal
+                              agreement protocol must drain ALL hosts
 
     Serving faults (docs/fault_tolerance.md), threaded through the
     inference engine's tick loop and admission path so every fleet
@@ -91,6 +99,11 @@ HANG_EXIT_CODE = 70
 #: exit code when a preemption checkpoint misses --preempt_save_timeout
 #: (EX_TEMPFAIL): the notice window closed with the save still in flight
 PREEMPT_TIMEOUT_EXIT_CODE = 75
+#: exit code when a host exits because a PEER died or published a poison
+#: record (EX_PROTOCOL): the cluster agreement said stop — distinct from
+#: this host's own hang (70) / preempt-timeout (75) verdicts so a fleet
+#: supervisor can tell the originating host from the collateral ones
+PEER_ABORT_EXIT_CODE = 76
 
 _parse_cache: Tuple[Optional[str], Dict[str, Tuple[int, ...]]] = (None, {})
 
@@ -189,6 +202,43 @@ def maybe_signal(kind: str, iteration: int,
             f"delivering {name}\n")
         sys.stderr.flush()
         _journal_fault(kind, iteration=iteration, signal=name)
+        os.kill(os.getpid(), signum)
+
+
+def host_fault_active(kind: str, host: int, iteration: int) -> bool:
+    """Whether the per-host fault `kind` (form kind:HOST:ITER) fires for
+    this (host, iteration) — the multi-host fault vocabulary: the fault
+    hits exactly ONE host of the cluster, and the test asserts what the
+    OTHERS do about it (docs/fault_tolerance.md)."""
+    args = fault_args(kind)
+    return (args is not None and len(args) >= 2
+            and args[0] == host and args[1] == iteration)
+
+
+def maybe_kill_host(host: int, iteration: int) -> None:
+    """SIGKILL this process iff kill_host:HOST:ITER names its coordination
+    host id — one host of the cluster dying unmaskably."""
+    if host_fault_active("kill_host", host, iteration):
+        sys.stderr.write(
+            f"MEGATRON_TPU_FAULT: kill_host firing on host {host} at "
+            f"iteration {iteration} — killing process\n")
+        sys.stderr.flush()
+        _journal_fault("kill_host", host=host, iteration=iteration)
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def maybe_signal_host(host: int, iteration: int,
+                      signum: int = signal.SIGTERM) -> None:
+    """Self-deliver SIGTERM iff preempt_host:HOST:ITER names this host —
+    the preemption notice landing on ONE host of the cluster."""
+    if host_fault_active("preempt_host", host, iteration):
+        name = signal.Signals(signum).name
+        sys.stderr.write(
+            f"MEGATRON_TPU_FAULT: preempt_host firing on host {host} at "
+            f"iteration {iteration} — delivering {name}\n")
+        sys.stderr.flush()
+        _journal_fault("preempt_host", host=host, iteration=iteration,
+                       signal=name)
         os.kill(os.getpid(), signum)
 
 
@@ -508,3 +558,96 @@ class DivergenceSentinel:
                     + self.ema_alpha * loss)
         self.n_finite += 1
         return None
+
+
+class CheckpointCadenceTuner:
+    """--save_interval auto: derive the checkpoint cadence from MEASURED
+    commit latency instead of a guessed constant.
+
+    The contract a preemption imposes: when the SIGTERM notice lands, the
+    expedited save must commit inside the grace window
+    (--preempt_save_timeout). The work at risk between checkpoints is
+    save_interval steps, so the rational cadence spends the window on
+    steps and reserves the measured p95 commit latency for the save:
+
+        save_interval ~= (grace_window - p95_commit) / p50_step_time
+
+    clamped below by --save_interval_floor (a pathological latency sample
+    must never collapse the run into saving every step). Inputs: per-step
+    wall seconds from the live run, commit latencies from the live run's
+    `checkpoint_commit` events plus — so the FIRST interval of a restart
+    is already informed — the journal's history of `checkpoint_commit`
+    and `preemption.save_latency_ms` records (seed_from_journal). Every
+    interval change is journaled as `cadence_retune`.
+    """
+
+    def __init__(self, grace_s: float, floor_steps: int = 25,
+                 max_steps: int = 100_000, window: int = 256):
+        if grace_s <= 0:
+            raise ValueError(
+                "--save_interval auto needs a positive --preempt_save_timeout"
+                " (the grace window the cadence is derived from)")
+        self.grace_s = float(grace_s)
+        self.floor_steps = max(int(floor_steps), 1)
+        self.max_steps = int(max_steps)
+        self._steps: List[float] = []
+        self._window = int(window)
+        self._commits: List[float] = []
+        self._last: Optional[int] = None
+
+    def seed_from_journal(self, events) -> int:
+        """Pre-load commit/preemption latencies from a prior journal;
+        returns how many samples were adopted."""
+        n = 0
+        for e in events:
+            kind = e.get("kind")
+            if kind == "checkpoint_commit" and "seconds" in e:
+                self.note_commit(float(e["seconds"]))
+                n += 1
+            elif kind == "preemption" and "save_latency_ms" in e:
+                self.note_commit(float(e["save_latency_ms"]) / 1e3)
+                n += 1
+        return n
+
+    def note_step(self, seconds: float) -> None:
+        self._steps.append(float(seconds))
+        if len(self._steps) > self._window:
+            del self._steps[:-self._window]
+
+    def note_commit(self, seconds: float) -> None:
+        self._commits.append(float(seconds))
+        if len(self._commits) > self._window:
+            del self._commits[:-self._window]
+
+    @staticmethod
+    def _pct(vals: List[float], q: float) -> float:
+        s = sorted(vals)
+        return s[min(len(s) - 1, max(0, round(q * (len(s) - 1))))]
+
+    def interval(self) -> Optional[int]:
+        """Current best interval in steps, or None while there is no step
+        sample yet (callers keep their previous/floor cadence)."""
+        if not self._steps:
+            return None
+        p50_step = self._pct(self._steps, 0.50)
+        p95_commit = self._pct(self._commits, 0.95) if self._commits else 0.0
+        budget = max(self.grace_s - p95_commit, 0.0)
+        raw = int(budget / max(p50_step, 1e-9))
+        return max(self.floor_steps, min(raw, self.max_steps))
+
+    def retune(self) -> Optional[Dict[str, float]]:
+        """interval() plus change tracking: returns a `cadence_retune`
+        journal payload when the interval moved, else None."""
+        it = self.interval()
+        if it is None or it == self._last:
+            return None
+        prev, self._last = self._last, it
+        return {
+            "from_interval": prev, "to_interval": it,
+            "grace_s": self.grace_s,
+            "p95_commit_ms": round(
+                self._pct(self._commits, 0.95) * 1e3, 1
+            ) if self._commits else 0.0,
+            "p50_step_ms": round(self._pct(self._steps, 0.50) * 1e3, 3),
+            "floor": self.floor_steps,
+        }

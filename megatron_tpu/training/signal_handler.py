@@ -2,9 +2,17 @@
 
 Equivalent of megatron/dist_signal_handler.py (81 LoC): install handlers
 that record the signal; the train loop polls and checkpoints-then-exits.
-The reference all-gathers the flag over NCCL so every rank agrees; in a
-single-controller JAX program the controller *is* the agreement point, so
-the handler is just a flag.
+The reference all-gathers the flag over NCCL so every rank agrees to exit
+together. Here the handler is deliberately just a LOCAL flag: within one
+JAX process the single controller already sees every device, and ACROSS
+processes (one per host on a real cluster) the train loop publishes what
+this handler recorded through the cross-process agreement seam
+(training/coordination.py) each loop pass and reads back the cluster-wide
+union — so a SIGTERM delivered to any one host drains and checkpoints ALL
+hosts (docs/fault_tolerance.md "Multi-host coordination"). The handler
+itself never touches the coordination backend: signal-handler context is
+the wrong place for filesystem/RPC work, and the loop-pass cadence bounds
+the propagation delay at one step.
 
 Beyond the reference: multiple signals are handled (SIGTERM from the
 cluster scheduler AND SIGINT from a human, by default), the handler

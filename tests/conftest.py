@@ -42,6 +42,65 @@ def pytest_configure(config):
 import pytest  # noqa: E402
 
 
+@pytest.fixture
+def jax_cluster(tmp_path):
+    """Shared harness: run N REAL jax.distributed CPU worker processes.
+
+    Replaces test_multihost.py's bespoke spawning (and its blanket skip
+    story) for everything that does NOT need cross-process XLA programs:
+    the coordination-service KV store, barriers, and the
+    training/coordination.py protocols all work for real on CPU — only
+    cross-process *computations* (device_put to a non-addressable
+    sharding) are unimplemented in this XLA:CPU.
+
+    Usage: `rcs_outs = jax_cluster(body_src, nprocs=2)` — `body_src` runs
+    in each worker after jax.distributed is initialized, with `pid`
+    (process id) in scope; returns [(returncode, output), ...].
+    """
+    import socket
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def run(body_src, nprocs=2, devices_per_proc=2, timeout=240,
+            env_extra=None):
+        with socket.socket() as s:
+            s.bind(("localhost", 0))
+            port = s.getsockname()[1]
+        prologue = f"""
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ["XLA_FLAGS"] = (
+    "--xla_force_host_platform_device_count={devices_per_proc}")
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid = int(sys.argv[1])
+jax.distributed.initialize(coordinator_address="localhost:{port}",
+                           num_processes={nprocs}, process_id=pid)
+"""
+        script = tmp_path / "cluster_worker.py"
+        script.write_text(prologue + body_src)
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+        env.update(env_extra or {})
+        procs = [subprocess.Popen(
+            [sys.executable, str(script), str(i)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env) for i in range(nprocs)]
+        out = []
+        for p in procs:
+            try:
+                stdout, _ = p.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                stdout, _ = p.communicate()
+            out.append((p.returncode, stdout))
+        return out
+
+    return run
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _bound_live_executables():
     """Free compiled executables between test modules.

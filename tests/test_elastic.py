@@ -254,6 +254,99 @@ def test_elastic_resume_dp4_to_dp2_sample_exact(tmp_path, corpus):
     assert "data-parallel degree dividing 8" in (d.stderr + d.stdout)
 
 
+@pytest.mark.slow  # 3 subprocess pretrain runs at 4/4/2 fake devices,
+# ~20s; the orbax reshard path was only dp-acceptance-tested before
+# (ISSUE 12 satellite) — this pins tp-change resume
+def test_elastic_resume_tp2_to_tp1_sample_exact(tmp_path, corpus):
+    """Model-parallel elastic resume: train at tp=2 (4 devices, dp=2),
+    preempt, resume at tp=1 (2 devices, dp=2 — accumulation unchanged,
+    only the tensor sharding moves). The orbax layer reshards on load;
+    per-step sample fingerprints must be identical and losses allclose
+    (tp changes matmul partial-sum order, nothing else), with the tp
+    change journaled as `elastic_resume`."""
+    from megatron_tpu.training import checkpointing
+
+    tp2 = ("--tensor_model_parallel_size", "2")
+    ref = _run_elastic(corpus, str(tmp_path / "ref"),
+                       str(tmp_path / "ref_tele"), n_devices=4, extra=tp2)
+    assert ref.returncode == 0, ref.stderr[-3000:]
+    _, oracle = _step_records(tmp_path / "ref_tele")
+    assert set(oracle) == set(range(1, 9))
+
+    save = str(tmp_path / "elastic")
+    b = _run_elastic(corpus, save, str(tmp_path / "b_tele"), n_devices=4,
+                     extra=tp2, fault="preempt_at:4")
+    assert b.returncode == 0, (b.returncode, b.stderr[-3000:])
+    assert checkpointing.read_tracker(save) == 4
+
+    # vocab padding is tp-dependent (pad_vocab_size: divisible_by * tp),
+    # so a naive tp-change resume is a LOUD refusal naming the drift —
+    # never a silent shape reinterpretation
+    bad = _run_elastic(corpus, save, str(tmp_path / "bad_tele"),
+                       n_devices=2, timeout=180)
+    assert bad.returncode != 0
+    assert "vocab_size: checkpoint=256 current=128" in bad.stderr
+
+    # the recipe: hold the PADDED vocab fixed across the tp change
+    c = _run_elastic(corpus, save, str(tmp_path / "c_tele"), n_devices=2,
+                     extra=("--make_vocab_size_divisible_by", "256"))
+    assert c.returncode == 0, (c.returncode, c.stderr[-3000:])
+    assert "elastic resume" in c.stdout
+    assert "tp 2->1" in c.stdout
+    evs, resumed = _step_records(tmp_path / "c_tele")
+    elastic = [e for e in evs if e["kind"] == "elastic_resume"]
+    assert elastic and elastic[0]["from_tp"] == 2
+    assert elastic[0]["to_tp"] == 1
+    assert elastic[0]["from_dp"] == 2 and elastic[0]["to_dp"] == 2
+    assert set(resumed) == set(range(5, 9))
+    for it in range(5, 9):
+        assert resumed[it]["data_crc"] == oracle[it]["data_crc"], it
+        assert (resumed[it]["consumed_samples"]
+                == oracle[it]["consumed_samples"])
+        np.testing.assert_allclose(resumed[it]["loss"], oracle[it]["loss"],
+                                   rtol=5e-4, atol=1e-5)
+    assert checkpointing.read_tracker(save) == 8
+
+
+@pytest.mark.slow  # 3 subprocess pretrain runs at 2/2/1 fake devices,
+# ~20s (ISSUE 12 satellite) — pins pp-change resume through the same
+# reshard path
+def test_elastic_resume_pp2_to_pp1_sample_exact(tmp_path, corpus):
+    """Pipeline-parallel elastic resume: train at pp=2 (2 devices, dp=1),
+    preempt, resume unpipelined on 1 device. Sample order invariant;
+    losses allclose (the pipeline schedule changes accumulation/summation
+    order only); `elastic_resume` journals the pp change."""
+    from megatron_tpu.training import checkpointing
+
+    pp2 = ("--pipeline_model_parallel_size", "2")
+    ref = _run_elastic(corpus, str(tmp_path / "ref"),
+                       str(tmp_path / "ref_tele"), n_devices=2, extra=pp2)
+    assert ref.returncode == 0, ref.stderr[-3000:]
+    _, oracle = _step_records(tmp_path / "ref_tele")
+    assert set(oracle) == set(range(1, 9))
+
+    save = str(tmp_path / "elastic")
+    b = _run_elastic(corpus, save, str(tmp_path / "b_tele"), n_devices=2,
+                     extra=pp2, fault="preempt_at:4")
+    assert b.returncode == 0, (b.returncode, b.stderr[-3000:])
+    assert checkpointing.read_tracker(save) == 4
+
+    c = _run_elastic(corpus, save, str(tmp_path / "c_tele"), n_devices=1)
+    assert c.returncode == 0, (c.returncode, c.stderr[-3000:])
+    assert "elastic resume" in c.stdout
+    assert "pp 2->1" in c.stdout
+    evs, resumed = _step_records(tmp_path / "c_tele")
+    elastic = [e for e in evs if e["kind"] == "elastic_resume"]
+    assert elastic and elastic[0]["from_pp"] == 2
+    assert elastic[0]["to_pp"] == 1
+    assert set(resumed) == set(range(5, 9))
+    for it in range(5, 9):
+        assert resumed[it]["data_crc"] == oracle[it]["data_crc"], it
+        np.testing.assert_allclose(resumed[it]["loss"], oracle[it]["loss"],
+                                   rtol=5e-4, atol=1e-5)
+    assert checkpointing.read_tracker(save) == 8
+
+
 def test_preempted_checkpoint_survives_pruning(tmp_path):
     """Satellite (ISSUE 11): prune_checkpoints never removes the newest
     preemption-tagged checkpoint regardless of --keep_latest_k; older

@@ -13,13 +13,19 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# This jax's XLA:CPU client cannot execute cross-process programs: a
+# This jax's XLA:CPU client cannot execute cross-process COMPUTATIONS: a
 # device_put of a host array to a non-addressable sharding (each process
 # holds only its slice of the global batch) routes through a multihost
-# broadcast that the CPU backend rejects with exactly this message. On a
-# real TPU backend the same code path works; the test must skip, not fail,
-# so the suite stays green on CPU CI while still running under
-# MEGATRON_TPU_TEST_PLATFORM=tpu captures (ROADMAP open item).
+# device broadcast that the CPU backend rejects with exactly this
+# message. On a real TPU backend the same code path works; the step test
+# must skip, not fail, so the suite stays green on CPU CI while still
+# running under MEGATRON_TPU_TEST_PLATFORM=tpu captures (ROADMAP item).
+# The skip is NARROW now: everything that is not an XLA program — the
+# jax.distributed coordination service, its KV store, barriers, and the
+# whole training/coordination.py protocol suite — runs FOR REAL on CPU
+# under the shared `jax_cluster` harness (test_two_process_host_broadcast
+# below + tests/test_coordination.py), so only the device-collective step
+# itself remains TPU-gated.
 _CPU_MULTIHOST_UNSUPPORTED = "Multiprocess computations aren't implemented"
 
 _WORKER = r"""
@@ -91,8 +97,50 @@ print(f"WORKER{pid} loss={loss:.6f}", flush=True)
 """
 
 
+_BCAST_WORKER = r"""
+import numpy as np
+from megatron_tpu.training.coordination import (
+    ClusterCoordinator, KVBackend)
+
+assert jax.process_count() == 2
+c = ClusterCoordinator(KVBackend(), pid, 2, peer_death_timeout_s=10,
+                       poll_s=0.05)
+c.topology_barrier(60)
+# host-data broadcast (the multihost-utils use case for SMALL host values:
+# agreed config, sampler seeds, resolved checkpoint iteration) over the
+# coordination service instead of an XLA device collective — which is why
+# it runs for real on XLA:CPU
+payload = {"seed": 1234, "resume_iteration": 40,
+           "order": list(np.arange(4).tolist())} if pid == 0 else None
+got = c.broadcast(payload, root=0, key="run_cfg", timeout_s=60)
+assert got == {"seed": 1234, "resume_iteration": 40, "order": [0, 1, 2, 3]}
+# rendezvous so neither side tears the service down under the other
+c.publish_value("done", True)
+import time
+deadline = time.monotonic() + 60
+while c.read_value("done", host=1 - pid) is None:
+    assert time.monotonic() < deadline
+    time.sleep(0.05)
+print(f"BCAST{pid} OK", flush=True)
+"""
+
+
+def test_two_process_host_broadcast(jax_cluster):
+    """The broadcast this file used to skip wholesale, run FOR REAL: two
+    jax.distributed CPU processes agree on one host value through the
+    coordination service's KV store (training/coordination.py broadcast).
+    Only the XLA *device* broadcast remains TPU-gated (test below)."""
+    results = jax_cluster(_BCAST_WORKER, nprocs=2, devices_per_proc=1,
+                          timeout=240)
+    for i, (rc, out) in enumerate(results):
+        assert rc == 0, f"worker {i} failed:\n{out}"
+        assert f"BCAST{i} OK" in out
+
+
 @pytest.mark.slow  # 10s measured on CPU — where it only SKIPS anyway
-# (multiprocess XLA:CPU unimplemented); real coverage runs under
+# (multiprocess XLA:CPU computations unimplemented; the non-XLA half of
+# multihost — coordination service, KV store, host broadcast — runs for
+# real above); device-collective coverage runs under
 # MEGATRON_TPU_TEST_PLATFORM=tpu
 def test_two_process_distributed_step(tmp_path):
     with socket.socket() as s:

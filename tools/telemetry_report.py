@@ -1,9 +1,16 @@
 #!/usr/bin/env python
-"""Summarize a telemetry event journal (docs/observability.md).
+"""Summarize telemetry event journals (docs/observability.md).
 
     python tools/telemetry_report.py runs/tele/events.jsonl
     python tools/telemetry_report.py runs/tele            # dir => events.jsonl
     python tools/telemetry_report.py runs/tele --json     # machine-readable
+    python tools/telemetry_report.py host0/tele host1/tele   # multi-host
+
+Several journals (one per host of a coordinated multi-host run) merge
+into ONE report: events are attributed to the host recorded on each
+journal's `run_start`, and a "coordination" section counts preemption
+notices by `notice_host`, peer aborts by (host, cause), and two-phase
+commit aborts — a multi-host post-mortem is one command.
 
 Reads the append-only JSONL journal a training run writes under
 --telemetry_dir (rotated segments included automatically) and reports:
@@ -59,6 +66,19 @@ def _segments(path: str) -> List[str]:
         out.append(f"{path}.{i}")
         i += 1
     return list(reversed(out))  # oldest first
+
+
+def load_journals(paths: List[str]) -> List[Dict[str, Any]]:
+    """Merge several hosts' journals into one event stream (one path per
+    host). Per-host attribution needs no annotation: every coordination
+    event already embeds the host ids that matter (`run_start.host`,
+    `preemption.notice_host`, `peer_abort.host`/`observed_by`,
+    `commit_abort.host`), which is exactly what _summarize_coordination
+    aggregates over."""
+    merged: List[Dict[str, Any]] = []
+    for path in paths:
+        merged.extend(load_journal(path))
+    return merged
 
 
 def percentile(sorted_vals: List[float], q: float) -> float:
@@ -159,6 +179,57 @@ def summarize(events: List[Dict[str, Any]], top_n: int = 5) -> Dict[str, Any]:
     serving = _summarize_serving(events)
     if serving:
         out["serving"] = serving
+    coord = _summarize_coordination(events)
+    if coord:
+        out["coordination"] = coord
+    return out
+
+
+def _summarize_coordination(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Multi-host coordination ledger (docs/fault_tolerance.md
+    "Multi-host coordination"): which host each preemption notice landed
+    on, peer aborts attributed by (dead host, cause), two-phase commit
+    aborts, and cadence retunes — the per-host attribution a multi-host
+    post-mortem starts from."""
+    out: Dict[str, Any] = {}
+    hosts = sorted({e["host"] for e in events
+                    if e.get("kind") == "run_start"
+                    and e.get("host") is not None})
+    if hosts:
+        out["hosts"] = hosts
+    # every host journals its own copy of a CLUSTER event (one
+    # preemption -> N `preemption` records, one torn commit -> up to N
+    # `commit_abort`s), so cluster incidents dedup by their identity
+    # (notice_host+iteration / iteration); per-host OBSERVATIONS
+    # (peer_abort) stay counted as such — who saw it is the information.
+    notices: Dict[str, int] = {}
+    for key in {(e["notice_host"], e.get("iteration")) for e in events
+                if e.get("kind") == "preemption"
+                and e.get("notice_host") is not None}:
+        label = f"host {key[0]}"
+        notices[label] = notices.get(label, 0) + 1
+    if notices:
+        out["preemption_notices_by_host"] = notices
+    peer: Dict[str, int] = {}
+    for e in events:
+        if e.get("kind") == "peer_abort":
+            key = f"host {e.get('host')}: {e.get('cause')}"
+            peer[key] = peer.get(key, 0) + 1
+    if peer:
+        out["peer_aborts"] = peer
+    commit_aborts = sorted({e.get("iteration") for e in events
+                            if e.get("kind") == "commit_abort"})
+    if commit_aborts:
+        out["commit_aborts"] = {
+            "total": len(commit_aborts),
+            "iterations": commit_aborts,
+        }
+    retunes = [e for e in events if e.get("kind") == "cadence_retune"]
+    if retunes:
+        out["cadence_retunes"] = {
+            "total": len(retunes),
+            "last_interval": retunes[-1].get("to_interval"),
+        }
     return out
 
 
@@ -311,18 +382,39 @@ def render(summary: Dict[str, Any]) -> str:
     if resilience_counts:
         lines.append("resilience: " + " | ".join(
             f"{summary[k]} {label}" for k, label in resilience_counts))
+    if "coordination" in summary:
+        co = summary["coordination"]
+        if co.get("hosts"):
+            lines.append(f"coordination: hosts {co['hosts']}")
+        if co.get("preemption_notices_by_host"):
+            lines.append("  preemption notices: " + " | ".join(
+                f"{k}: {v}"
+                for k, v in co["preemption_notices_by_host"].items()))
+        if co.get("peer_aborts"):
+            lines.append("  peer aborts: " + " | ".join(
+                f"{k}: {v}" for k, v in co["peer_aborts"].items()))
+        if co.get("commit_aborts"):
+            ca = co["commit_aborts"]
+            lines.append(f"  commit aborts: {ca['total']} "
+                         f"@ iterations {ca['iterations']}")
+        if co.get("cadence_retunes"):
+            cr = co["cadence_retunes"]
+            lines.append(f"  cadence retunes: {cr['total']} "
+                         f"(current interval {cr['last_interval']})")
     return "\n".join(lines)
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("journal", help="journal file or its telemetry dir")
+    ap.add_argument("journal", nargs="+",
+                    help="journal file(s) or telemetry dir(s) — pass one "
+                         "per host for a merged multi-host report")
     ap.add_argument("--json", action="store_true",
                     help="emit the summary as one JSON object")
     ap.add_argument("--top", type=int, default=5,
                     help="entries in the stall top-list")
     args = ap.parse_args(argv)
-    summary = summarize(load_journal(args.journal), top_n=args.top)
+    summary = summarize(load_journals(args.journal), top_n=args.top)
     print(json.dumps(summary, indent=1) if args.json else render(summary))
     return 0
 
