@@ -1241,6 +1241,29 @@ def main():
                 time_step(state, step, batch, iters=3)
             finally:
                 jax.profiler.stop_trace()
+            try:
+                # attach the comm/compute/exposed split to the headline
+                # detail: when a chip window finally appears, the round's
+                # record carries the Flash-Communication numbers, not
+                # just MFU (megatron_tpu/telemetry/tracing/)
+                from megatron_tpu.telemetry.tracing import (
+                    analyze_events, classify_xspace, find_xplane_files,
+                    load_xspace,
+                )
+
+                trace_events = []
+                for f in find_xplane_files(profile_dir):
+                    trace_events.extend(
+                        classify_xspace(load_xspace(f)))
+                rep = analyze_events(trace_events).to_dict(top=0)
+                extras["trace_split"] = {
+                    k: rep[k] for k in ("module", "busy_s",
+                                        "exposed_collective_s",
+                                        "collectives")}
+            except Exception as e:  # noqa: BLE001 - the trace stays on
+                # disk either way; a decode hiccup must not cost the
+                # round its headline
+                extras["trace_split_error"] = str(e)[:200]
     except Exception as e:  # noqa: BLE001
         extras["post_search_error"] = str(e)[:300]
         print(f"# post-search work failed, keeping best: {e}", file=sys.stderr)

@@ -37,18 +37,13 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 
-COLLECTIVE_PRIMITIVES = {
-    "psum", "pmax", "pmin", "ppermute", "pbroadcast", "all_gather",
-    "all_to_all", "reduce_scatter", "psum_scatter", "pgather",
-    "ragged_all_to_all",
-}
-CALLBACK_PRIMITIVES = {
-    "pure_callback", "io_callback", "debug_callback", "outside_call",
-}
-#: HLO ops counted by `hlo_collectives` (post-SPMD-partitioning view)
-HLO_COLLECTIVE_OPS = (
-    "all-reduce", "all-gather", "all-to-all", "collective-permute",
-    "reduce-scatter", "collective-broadcast", "ragged-all-to-all",
+# The op vocabulary lives in analysis/taxonomy.py (stdlib-only) so the
+# runtime trace analyzer (telemetry/tracing) classifies profiler events
+# against the SAME names without paying a jax import; re-exported here
+# because the audit API predates the split.
+from megatron_tpu.analysis.taxonomy import (  # noqa: F401
+    CALLBACK_PRIMITIVES, COLLECTIVE_PRIMITIVES, HLO_COLLECTIVE_OPS,
+    HLO_DTYPE_BITS,
 )
 
 
@@ -356,12 +351,7 @@ _HLO_LINE = re.compile(
     r"(?P<op>" + "|".join(HLO_COLLECTIVE_OPS) + r")(?:-start)?\(")
 _HLO_SHAPE = re.compile(
     r"(?P<dtype>pred|[a-z]+\d+(?:e\dm\d)?)\[(?P<dims>[\d,]*)\]")
-_HLO_DTYPE_BITS = {
-    "pred": 8, "s8": 8, "u8": 8, "f8e4m3": 8, "f8e5m2": 8,
-    "s16": 16, "u16": 16, "f16": 16, "bf16": 16,
-    "s32": 32, "u32": 32, "f32": 32,
-    "s64": 64, "u64": 64, "f64": 64, "c64": 64, "c128": 128,
-}
+_HLO_DTYPE_BITS = HLO_DTYPE_BITS
 
 
 def hlo_collectives(compiled_text: str) -> Dict[str, Dict[str, int]]:

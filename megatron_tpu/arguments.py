@@ -394,6 +394,9 @@ def build_parser(extra_args_provider=None) -> argparse.ArgumentParser:
                         "equivalent) for steps [start, end)")
     g.add_argument("--profile_step_start", type=int, default=10)
     g.add_argument("--profile_step_end", type=int, default=12)
+    g.add_argument("--profile_signal_steps", type=int, default=2,
+                   help="steps traced when SIGUSR1 arms an on-demand "
+                        "profile window mid-run (no --profile needed)")
     g.add_argument("--profile_dir", default=None,
                    help="trace output dir (default: --tensorboard_dir)")
 
@@ -696,6 +699,7 @@ def args_to_run_config(args) -> RunConfig:
         profile=getattr(args, "profile", False),
         profile_step_start=getattr(args, "profile_step_start", 10),
         profile_step_end=getattr(args, "profile_step_end", 12),
+        profile_signal_steps=getattr(args, "profile_signal_steps", 2),
         profile_dir=getattr(args, "profile_dir", None),
         telemetry_dir=getattr(args, "telemetry_dir", None),
         journal_max_mb=getattr(args, "journal_max_mb", 64.0),

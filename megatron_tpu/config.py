@@ -566,6 +566,10 @@ class TrainingConfig:
     profile_step_start: int = 10
     profile_step_end: int = 12
     profile_dir: Optional[str] = None
+    # SIGUSR1 mid-run arms a bounded trace window of this many steps —
+    # on-demand incident profiling with no restart and no --profile
+    # (docs/observability.md "Runtime traces")
+    profile_signal_steps: int = 2
 
     # telemetry (megatron_tpu/telemetry; docs/observability.md):
     # structured event journal (per-step records, goodput ledger,

@@ -51,6 +51,11 @@ from megatron_tpu.training import resilience
 #: fallback (the _pick_block -> ValueError -> dispatcher chain).
 KERNEL_SEQ_MULTIPLE = 128
 
+#: jax's profiler session is process-global (one trace at a time), so
+#: on-demand captures serialize here — a second /admin/profile while one
+#: is running answers 409 instead of corrupting the live session
+_PROFILE_LOCK = threading.Lock()
+
 
 class EngineOverloadedError(RuntimeError):
     """The engine's admission queue is at max_queue: the request was
@@ -1084,6 +1089,43 @@ class InferenceEngine:
                     or self._admitting > 0)
         return (busy and
                 time.monotonic() - self.last_progress_time > threshold_s)
+
+    def capture_trace(self, out_dir: str, ticks: int = 4,
+                      timeout_s: float = 30.0) -> dict:
+        """On-demand profiler capture of >= `ticks` decode ticks under
+        live traffic (the /admin/profile endpoint; docs/observability.md
+        "Runtime traces").
+
+        Runs entirely on the CALLER's thread: jax's profiler session is
+        process-global, so bracketing start/stop around the step loop
+        from outside traces every device op the loop dispatches — the
+        loop itself has NO per-tick check, no extra traced args (zero
+        decode recompiles) and zero steady-state overhead when no
+        capture is armed. Tick progress is read off ``stats["ticks"]``;
+        an idle engine makes no ticks, so the window closes at
+        `timeout_s` with whatever it saw (``complete`` says which).
+        """
+        if not _PROFILE_LOCK.acquire(blocking=False):
+            raise RuntimeError(
+                "a profiler capture is already in progress (the jax "
+                "profiler traces the whole process; retry when it ends)")
+        try:
+            start_ticks = self.stats["ticks"]
+            t0 = time.monotonic()
+            jax.profiler.start_trace(out_dir)
+            try:
+                while (self.stats["ticks"] - start_ticks < ticks
+                       and time.monotonic() - t0 < timeout_s):
+                    time.sleep(0.005)
+            finally:
+                jax.profiler.stop_trace()
+        finally:
+            _PROFILE_LOCK.release()
+        captured = self.stats["ticks"] - start_ticks
+        return {"dir": out_dir, "ticks": int(captured),
+                "requested_ticks": int(ticks),
+                "complete": captured >= ticks,
+                "wall_s": round(time.monotonic() - t0, 3)}
 
     def _track_decode_recompiles(self) -> None:
         """Enforce the zero-recompiles-after-warmup invariant as a live

@@ -24,30 +24,124 @@ Samples = Dict[str, List[Tuple[Dict[str, str], float]]]
 _SAMPLE_RE = re.compile(
     r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)\s*$")
 _LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+#: strict mode: the whole brace interior must be well-formed pairs
+#: (commas inside quoted values are fine — the value part is quoted)
+_LABEL_PAIR = r'[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+_LABELS_FULL_RE = re.compile(
+    rf"^(?:{_LABEL_PAIR})(?:,{_LABEL_PAIR})*,?$")
+_TYPE_RE = re.compile(
+    r"^#\s+TYPE\s+([a-zA-Z_:][a-zA-Z0-9_:]*)\s+(\w+)\s*$")
+_HELP_RE = re.compile(r"^#\s+HELP\s+([a-zA-Z_:][a-zA-Z0-9_:]*)\s?(.*)$")
+#: valid label-value escapes per the text format 0.0.4
+_ESCAPE_RE = re.compile(r'\\(.)')
+#: suffixes a histogram family's samples carry beyond its TYPE name
+_HISTOGRAM_SUFFIXES = ("_bucket", "_sum", "_count")
 
 
-def parse_prom_text(text: str) -> Samples:
-    """Parse Prometheus text exposition into {name: [(labels, value)]}."""
+class ScrapeFormatError(ValueError):
+    """Strict-mode parse failure: the exposition violates the format."""
+
+
+def _unescape_label(value: str, strict: bool) -> str:
+    def sub(m):
+        c = m.group(1)
+        if c == "n":
+            return "\n"
+        if c in ('"', "\\"):
+            return c
+        if strict:
+            raise ScrapeFormatError(
+                f"invalid label escape \\{c} (only \\\\, \\\", \\n)")
+        # lenient: a third-party exposition's unknown escape passes
+        # through VERBATIM (backslash kept) — dropping the backslash
+        # would silently change the label value it keys series by
+        return m.group(0)
+    return _ESCAPE_RE.sub(sub, value)
+
+
+def parse_prom_text(text: str, strict: bool = False) -> Samples:
+    """Parse Prometheus text exposition into {name: [(labels, value)]}.
+
+    strict=True enforces the format instead of skipping what doesn't
+    parse: every non-comment line must be a valid sample with a parsable
+    value, every sample's family must have been declared by a `# TYPE`
+    line (histograms cover their `_bucket`/`_sum`/`_count` series), a
+    family must not be re-declared, and label escapes must be the three
+    legal ones. This is the round-trip gate on our own exposition
+    (telemetry/metrics.py) — a renderer regression fails loudly here
+    rather than silently dropping series off the router's scrape."""
     out: Samples = {}
-    for line in text.splitlines():
+    types: Dict[str, str] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
         line = line.strip()
-        if not line or line.startswith("#"):
+        if not line:
+            continue
+        if line.startswith("#"):
+            m = _TYPE_RE.match(line)
+            if m:
+                name, kind = m.groups()
+                if strict and name in types:
+                    raise ScrapeFormatError(
+                        f"line {lineno}: family {name} re-declared")
+                types[name] = kind
             continue
         m = _SAMPLE_RE.match(line)
         if not m:
+            if strict:
+                raise ScrapeFormatError(
+                    f"line {lineno}: not a sample line: {line!r}")
             continue
         name, labelstr, raw = m.groups()
-        # single-pass unescape: sequential str.replace would corrupt a
-        # literal backslash before 'n' ('\\n' -> newline instead of \n)
-        labels = {k: re.sub(r'\\(["\\n])',
-                            lambda e: "\n" if e.group(1) == "n"
-                            else e.group(1), v)
+        if strict:
+            family = name
+            if family not in types:
+                for suffix in _HISTOGRAM_SUFFIXES:
+                    base = name[:-len(suffix)] if name.endswith(suffix) \
+                        else None
+                    if base and types.get(base) == "histogram":
+                        family = base
+                        break
+                else:
+                    raise ScrapeFormatError(
+                        f"line {lineno}: sample {name} has no # TYPE "
+                        "declaration")
+            if labelstr and not _LABELS_FULL_RE.match(labelstr):
+                # a malformed fragment between/after valid pairs would
+                # otherwise be silently dropped
+                raise ScrapeFormatError(
+                    f"line {lineno}: malformed labels {{{labelstr}}}")
+        labels = {k: _unescape_label(v, strict)
                   for k, v in _LABEL_RE.findall(labelstr or "")}
         try:
             value = float(raw)
         except ValueError:
+            if strict:
+                raise ScrapeFormatError(
+                    f"line {lineno}: unparsable value {raw!r}")
             continue
         out.setdefault(name, []).append((labels, value))
+    return out
+
+
+def parse_prom_metadata(text: str) -> Dict[str, Dict[str, str]]:
+    """{family: {"type": kind, "help": unescaped help}} off the comment
+    lines — the metadata half of the round-trip with
+    telemetry/metrics.py (_escape_help is the inverse)."""
+    out: Dict[str, Dict[str, str]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        m = _HELP_RE.match(line)
+        if m:
+            name, help_ = m.groups()
+            # single-pass unescape, the inverse of metrics._escape_help
+            out.setdefault(name, {})["help"] = re.sub(
+                r"\\(.)",
+                lambda e: "\n" if e.group(1) == "n" else e.group(1),
+                help_)
+            continue
+        m = _TYPE_RE.match(line)
+        if m:
+            out.setdefault(m.group(1), {})["type"] = m.group(2)
     return out
 
 

@@ -24,6 +24,12 @@ Fleet control plane (POST, docs/serving.md "Fleet"):
                                     update_params between decode ticks
                                     (zero recompiles, zero dropped
                                     requests)
+  /admin/profile  {"steps": N}      on-demand profiler capture: trace N
+                                    decode ticks under live traffic into
+                                    an xplane dir readable by
+                                    tools/trace_report.py (also accepts
+                                    ?steps=N query form; zero recompiles,
+                                    zero overhead while disarmed)
   /admin/status                     (GET) draining/ready/weights_version/
                                     engine stats
 
@@ -104,7 +110,8 @@ class GenerationService:
                  warmup: bool = False,
                  speculative: Optional[str] = None,
                  spec_k: int = 4,
-                 draft_cfg=None, draft_params=None):
+                 draft_cfg=None, draft_params=None,
+                 profile_dir: Optional[str] = None):
         """mesh + forward_fn serve sharded models: the mesh becomes
         ambient around generation (GSPMD handles tp/cp), forward_fn is the
         pp>1 pipelined forward (ref ForwardStep, forward_step.py:45-204).
@@ -152,6 +159,9 @@ class GenerationService:
         self.kv_cache_int8 = kv_cache_int8
         self.request_timeout = request_timeout
         self.reload_dir = reload_dir
+        # default output dir for /admin/profile captures (each capture
+        # lands in its own plugins/profile/<session> subdir)
+        self.profile_dir = profile_dir or "runs/serve_profile"
         self.weights_version = weights_version
         self.stall_threshold_s = stall_threshold_s
         self.draining = False
@@ -335,6 +345,45 @@ class GenerationService:
                 return it
             finally:
                 self.reloading = False
+
+    def profile(self, steps: int = 4, timeout_s: float = 30.0,
+                out_dir: Optional[str] = None) -> dict:
+        """On-demand profiler capture under live traffic (POST
+        /admin/profile): trace `steps` decode ticks into the xplane dir
+        tools/trace_report.py reads. No restart, no admission pause —
+        the step loop never checks a flag (the capture brackets it from
+        this thread), so a disarmed server pays nothing and the capture
+        itself causes zero decode recompiles. Begin/end are journaled so
+        the incident timeline shows when the trace was cut."""
+        if self.engine is None:
+            raise ValueError(
+                "on-demand profiling needs the continuous-batching "
+                "engine (engine_slots > 0); one-shot servers can be "
+                "traced externally with jax.profiler")
+        steps = int(steps)
+        if not 1 <= steps <= 10_000:
+            raise ValueError("steps must be in [1, 10000]")
+        timeout_s = float(timeout_s)
+        if not 0 < timeout_s <= 600:
+            # the capture holds the process-global profiler session (and
+            # its in-memory trace buffer) for up to this long — an
+            # unbounded client value could wedge profiling for days
+            raise ValueError("timeout_s must be in (0, 600]")
+        out = out_dir or self.profile_dir
+        self._journal("profile_begin", source="admin", dir=out,
+                      steps=steps)
+        try:
+            result = self.engine.capture_trace(
+                out, ticks=steps, timeout_s=timeout_s)
+        except BaseException as e:  # noqa: BLE001 - re-raised below: the
+            # catch only journals the abort — a begin with no end would
+            # mis-pair the NEXT window in the perfetto timeline, so this
+            # one closes as aborted (busy lock, profiler error) first
+            self._journal("profile_aborted", source="admin",
+                          reason=type(e).__name__, flushed=False)
+            raise
+        self._journal("profile_end", source="admin", **result)
+        return result
 
     def admin_status(self) -> dict:
         ok, detail = self.ready()
@@ -525,9 +574,24 @@ def make_handler(service: GenerationService):
                         load=req.get("load"),
                         iteration=req.get("iteration"))
                     self._reply(200, {"version": version})
+                elif path == "/admin/profile":
+                    from urllib.parse import parse_qs, urlsplit
+
+                    q = parse_qs(urlsplit(self.path).query)
+                    steps = req.get("steps", q.get("steps", ["4"])[0])
+                    timeout_s = req.get(
+                        "timeout_s", q.get("timeout_s", ["30"])[0])
+                    try:
+                        self._reply(200, service.profile(
+                            steps=int(steps), timeout_s=float(timeout_s),
+                            out_dir=req.get("dir")))
+                    except RuntimeError as e:
+                        # another capture owns the process-global
+                        # profiler session: conflict, retry later
+                        self._reply(409, {"message": str(e)})
                 else:
-                    self._reply(404, {"message":
-                                      "POST /admin/{drain,readmit,reload}"})
+                    self._reply(404, {"message": "POST /admin/"
+                                      "{drain,readmit,reload,profile}"})
             except NoValidCheckpointError as e:
                 # no verifiable committed checkpoint: an operator/ckpt
                 # problem, not a server fault — 409 so the router's
@@ -596,7 +660,8 @@ def run_server(cfg: ModelConfig, params: Any, tokenizer,
                stall_threshold_s: float = STALL_THRESHOLD_SECONDS,
                speculative: Optional[str] = None,
                spec_k: int = 4,
-               draft_cfg=None, draft_params=None) -> None:
+               draft_cfg=None, draft_params=None,
+               profile_dir: Optional[str] = None) -> None:
     """Serve until killed. SIGTERM/SIGINT triggers a graceful drain
     (mirroring DistributedSignalHandler): stop admitting (503 +
     Retry-After), finish in-flight requests up to `drain_timeout`, then
@@ -620,7 +685,8 @@ def run_server(cfg: ModelConfig, params: Any, tokenizer,
                                 warmup=warmup,
                                 speculative=speculative, spec_k=spec_k,
                                 draft_cfg=draft_cfg,
-                                draft_params=draft_params)
+                                draft_params=draft_params,
+                                profile_dir=profile_dir)
     server = ThreadingHTTPServer((host, port), make_handler(service))
     bound_port = server.server_address[1]
     if port_file:

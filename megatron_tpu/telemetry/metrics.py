@@ -39,6 +39,13 @@ def _escape_label_value(v: str) -> str:
             .replace('"', r'\"'))
 
 
+def _escape_help(s: str) -> str:
+    """HELP text escaping per the text format: backslash and newline
+    only (quotes stay literal in HELP, unlike label values). Symmetric
+    with fleet/scrape.py parse_prom_metadata."""
+    return s.replace("\\", r"\\").replace("\n", r"\n")
+
+
 def _format_labels(labels: Tuple[Tuple[str, str], ...],
                    extra: Tuple[Tuple[str, str], ...] = ()) -> str:
     items = labels + extra
@@ -76,7 +83,12 @@ class _Collector:
         raise NotImplementedError
 
     def expose(self) -> str:
-        lines = [f"# HELP {self.name} {self.help}",
+        # every family gets a HELP and a TYPE line (strict scrapers —
+        # fleet/scrape.py parse_prom_text(strict=True) — reject samples
+        # of undeclared families); empty help falls back to the name so
+        # the HELP line is never blank, and the text is escaped so a
+        # newline in a help string can't inject a bogus sample line
+        lines = [f"# HELP {self.name} {_escape_help(self.help or self.name)}",
                  f"# TYPE {self.name} {self.kind}"]
         lines.extend(self.samples())
         return "\n".join(lines)

@@ -1,0 +1,137 @@
+"""Typed, classified op events out of a decoded XSpace (stdlib only).
+
+Classification keys off what XLA's runtime stamps on each event rather
+than which plane/line it sits on, so the same walk reads XLA:CPU traces
+(op events live on host thread-pool lines — what tier-1 exercises) and
+TPU traces (op events live on ``/device:TPU:N`` lines):
+
+  * an event carrying an ``hlo_op``/``hlo_module`` stat — or sitting on
+    a device plane's "XLA Ops" line — is an **XLA op**, split
+    collective / transfer / compute by HLO name against
+    ``analysis/taxonomy.py`` (the same vocabulary the golden comm
+    contracts count);
+  * everything else is **host** activity (python dispatch, runtime
+    bookkeeping, thread-pool markers). ``PjitFunction(fn)`` host events
+    are the step markers the analyzer derives per-step wall from.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, Iterable, List, Optional
+
+from megatron_tpu.analysis.taxonomy import collective_base, is_transfer
+from megatron_tpu.telemetry.tracing.xplane import XSpace, iter_events
+
+KIND_COMPUTE = "compute"
+KIND_COLLECTIVE = "collective"
+KIND_INFEED = "infeed"
+KIND_HOST = "host"
+
+#: python dispatch events naming the jitted callable — the step markers
+PJIT_RE = re.compile(r"^PjitFunction\((.+)\)$")
+
+#: TPU device planes put op events on THIS line even when individual
+#: events lack hlo stats. "Steps" and "XLA Modules" lines deliberately
+#: stay host-kind: their events are whole-step/whole-module ENVELOPES —
+#: classified as compute they would cover the entire plane and zero out
+#: every collective's exposed time (the number this package exists for)
+_DEVICE_OP_LINES = ("XLA Ops",)
+_DEVICE_MARKER_LINES = ("Steps", "XLA Modules")
+
+
+@dataclasses.dataclass
+class OpEvent:
+    name: str
+    kind: str            # compute | collective | transfer | host
+    start_ps: int
+    duration_ps: int
+    plane: str
+    line: str
+    module: Optional[str] = None      # hlo_module ("jit_train_step")
+    program_id: Optional[int] = None
+    collective: Optional[str] = None  # base mnemonic ("all-reduce")
+
+    @property
+    def end_ps(self) -> int:
+        return self.start_ps + self.duration_ps
+
+
+def classify_xspace(space: XSpace) -> List[OpEvent]:
+    """Every event in the space as a classified OpEvent, time-sorted."""
+    out: List[OpEvent] = []
+    for plane, line, ev in iter_events(space):
+        stats = ev.stats
+        on_device = plane.name.startswith("/device:")
+        is_xla_op = ((("hlo_module" in stats or "hlo_op" in stats)
+                      and not (on_device
+                               and line.name in _DEVICE_MARKER_LINES))
+                     or (on_device and line.name in _DEVICE_OP_LINES))
+        if is_xla_op:
+            name = stats.get("hlo_op") or ev.name
+            if not isinstance(name, str):
+                name = ev.name
+            base = collective_base(name)
+            kind = (KIND_COLLECTIVE if base
+                    else KIND_INFEED if is_transfer(name)
+                    else KIND_COMPUTE)
+            module = stats.get("hlo_module")
+            pid = stats.get("program_id")
+            out.append(OpEvent(
+                name=name, kind=kind, start_ps=ev.start_ps,
+                duration_ps=ev.duration_ps, plane=plane.name,
+                line=line.name,
+                module=module if isinstance(module, str) else None,
+                program_id=pid if isinstance(pid, int) else None,
+                collective=base))
+        else:
+            out.append(OpEvent(
+                name=ev.name, kind=KIND_HOST, start_ps=ev.start_ps,
+                duration_ps=ev.duration_ps, plane=plane.name,
+                line=line.name))
+    out.sort(key=lambda e: (e.start_ps, e.end_ps))
+    return out
+
+
+def op_events(events: Iterable[OpEvent]) -> List[OpEvent]:
+    """XLA op events only (compute + collective + transfer)."""
+    return [e for e in events if e.kind != KIND_HOST]
+
+
+def modules(events: Iterable[OpEvent]) -> Dict[str, int]:
+    """module name -> total op picoseconds, for dominant-module picking."""
+    out: Dict[str, int] = {}
+    for e in events:
+        if e.kind != KIND_HOST and e.module:
+            out[e.module] = out.get(e.module, 0) + e.duration_ps
+    return out
+
+
+def step_markers(events: Iterable[OpEvent]) -> Dict[str, List[OpEvent]]:
+    """Host-side step markers: ``PjitFunction(fn)`` dispatch events
+    grouped by fn, plus TPU "Steps"-line events grouped by name.
+
+    The runtime emits the python dispatch span twice (a python-level and
+    a C++ TraceMe with the same name, one nested in the other), so a
+    marker contained within the previously kept marker of the same name
+    is folded — one span per actual dispatch."""
+    out: Dict[str, List[OpEvent]] = {}
+    for e in events:
+        if e.kind == KIND_HOST:
+            m = PJIT_RE.match(e.name)
+            if m and e.duration_ps > 0:
+                out.setdefault(m.group(1), []).append(e)
+            elif e.line == "Steps" and e.duration_ps > 0:
+                # TPU "Steps"-line envelopes (host-kind markers)
+                out.setdefault(e.name, []).append(e)
+    deduped: Dict[str, List[OpEvent]] = {}
+    for name, marks in out.items():
+        marks.sort(key=lambda e: (e.start_ps, -e.end_ps))
+        kept: List[OpEvent] = []
+        for e in marks:
+            if kept and e.end_ps <= kept[-1].end_ps:
+                continue  # nested duplicate of the same dispatch
+            kept.append(e)
+        deduped[name] = kept
+    return deduped

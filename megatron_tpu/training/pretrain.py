@@ -185,6 +185,11 @@ class TrainLoop:
             level = max(level, 1)  # sub-spans become real timers
         self.timers = Timers(level)
         self._profiling = False
+        # SIGUSR1 arms a bounded trace window at the next loop pass —
+        # production incidents get profiled without a restart or
+        # --profile having been set (docs/observability.md)
+        self._profile_signal_pending = False
+        self._profile_until: Optional[int] = None
 
         model_cfg = run_cfg.model
         E = model_cfg.num_experts
@@ -639,6 +644,18 @@ class TrainLoop:
         self._stop_watchdog()  # the preempt deadline takes over
         first = sig.first_signal()
         notice_t = first[1] if first else time.monotonic()
+        # a profile window still open would burn grace time and die torn
+        # with the process — flush it NOW while the disk is still ours,
+        # but never let the flush spend more than a sliver of the grace
+        # window: the checkpoint is what the window exists to protect
+        if self._profiling:
+            flush_budget = 10.0
+            if t.preempt_save_timeout:
+                remaining = (t.preempt_save_timeout
+                             - (time.monotonic() - notice_t))
+                flush_budget = min(10.0, max(remaining * 0.2, 1.0))
+            self._profile_abort("preemption",
+                                flush_timeout_s=flush_budget)
         # the deadline is anchored at the NOTICE's arrival, not at this
         # call: the in-flight iteration + eval + drain between the two
         # already spent part of the grace window, and granting the save a
@@ -756,6 +773,9 @@ class TrainLoop:
         self.log(f"step watchdog: no progress for {age:.1f}s "
                  f"(step_timeout_s={t.step_timeout_s}) at iteration "
                  f"~{stuck_at} — dumping flight bundle and aborting")
+        # os._exit below would tear a live trace window; flush it first —
+        # a trace ENDING at the hang is exactly the evidence wanted
+        self._profile_abort("hang")
         bundle = None
         try:
             flight = self.telemetry.flight if self.telemetry else None
@@ -814,6 +834,7 @@ class TrainLoop:
         self.log(f"peer abort: host {host} ({cause}) — exiting "
                  f"{resilience.PEER_ABORT_EXIT_CODE} "
                  f"({verdict.get('detail', '')})")
+        self._profile_abort("peer_abort")  # os._exit would tear the trace
         if self.telemetry is not None:
             self.telemetry.emit(
                 "peer_abort", host=host, cause=cause,
@@ -1192,33 +1213,117 @@ class TrainLoop:
     # -- profiling ----------------------------------------------------------
 
     def _profile_window(self):
-        """Opt-in jax.profiler trace of [profile_step_start,
-        profile_step_end) — device + host timeline into the tensorboard
-        dir, the TPU-native equivalent of the reference's nsys runs.
-        Called before each iteration; self.iteration is the number of
-        COMPLETED iterations, so start/stop fire before the steps whose
-        1-based index enters/leaves the window. Range (not equality)
-        checks so a resume landing mid-window, or a start step the caller
-        skipped, still gets a trace of the remaining window."""
+        """jax.profiler trace windows — device + host timeline into the
+        profile dir, the TPU-native equivalent of the reference's nsys
+        runs; read the result with tools/trace_report.py.
+
+        Two arming paths share one window: the static --profile window
+        [profile_step_start, profile_step_end), and a SIGUSR1 received
+        mid-run, which opens a --profile_signal_steps window at the next
+        pass (on-demand incident profiling, no restart, no --profile
+        required). Called before each iteration; self.iteration is the
+        number of COMPLETED iterations, so start/stop fire before the
+        steps whose 1-based index enters/leaves the window. Range (not
+        equality) checks so a resume landing mid-window, or a start step
+        the caller skipped, still gets a trace of the remaining
+        window."""
         t = self.cfg.training
-        if not t.profile:
-            return
-        out = t.profile_dir or t.tensorboard_dir or "runs/profile"
         nxt = self.iteration + 1
-        if (not self._profiling
+        if self._profiling:
+            if self._profile_until is not None and nxt >= self._profile_until:
+                self._profile_stop()
+            return
+        if self._profile_signal_pending:
+            self._profile_signal_pending = False
+            self._profile_start(nxt, nxt + max(t.profile_signal_steps, 1),
+                                source="SIGUSR1")
+        elif (t.profile
                 and t.profile_step_start <= nxt < t.profile_step_end):
+            self._profile_start(nxt, t.profile_step_end, source="--profile")
+
+    def _profile_out_dir(self) -> str:
+        t = self.cfg.training
+        return (t.profile_dir or t.tensorboard_dir
+                or (os.path.join(t.telemetry_dir, "traces")
+                    if t.telemetry_dir else "runs/profile"))
+
+    def _profile_start(self, start: int, until: int, source: str) -> None:
+        out = self._profile_out_dir()
+        try:
             jax.profiler.start_trace(out)
-            self._profiling = True
-            self.log(f"profiler: tracing steps [{t.profile_step_start}, "
-                     f"{t.profile_step_end}) to {out}")
-        elif self._profiling and nxt >= t.profile_step_end:
-            self._profile_stop()
+        except Exception as e:  # noqa: BLE001 - a capture already owned
+            # by /admin-style tooling (the profiler session is process-
+            # global) must not kill the run; the window is just skipped
+            self.log(f"profiler: could not start trace ({e})")
+            return
+        self._profiling = True
+        self._profile_until = until
+        self.log(f"profiler: tracing steps [{start}, {until}) to {out}")
+        if self.telemetry is not None:
+            self.telemetry.emit("profile_begin", iteration=start,
+                                until=until, dir=out, source=source)
 
     def _profile_stop(self):
-        if self._profiling:
+        if not self._profiling:
+            return
+        self._profiling = False
+        self._profile_until = None
+        try:
             jax.profiler.stop_trace()
-            self._profiling = False
-            self.log("profiler: trace written")
+        except Exception as e:  # noqa: BLE001 - an abort path on another
+            # thread (peer-abort sideband) may have closed the session
+            # between our flag check and here; the journal has its story
+            self.log(f"profiler: stop_trace failed ({e})")
+            return
+        self.log("profiler: trace written")
+        if self.telemetry is not None:
+            self.telemetry.emit("profile_end",
+                                iteration=self.iteration,
+                                dir=self._profile_out_dir())
+
+    def _profile_abort(self, reason: str, flush: bool = True,
+                       flush_timeout_s: float = 10.0) -> None:
+        """Close a live trace window on an abort path. A window left
+        open across os._exit (or burned grace time mid-preemption) is a
+        torn, unreadable trace; flushing when the path allows it keeps
+        the evidence, and either way `profile_aborted` lands in the
+        journal so the post-mortem knows whether the file is usable.
+
+        The flush runs on a bounded helper thread: stop_trace writes
+        files and (on a real chip) collects device-side data, and the
+        very conditions that bring us here — a hung step, a wedged
+        filesystem — are the ones where it could block forever; a
+        deliberate abort must never be stalled by its own evidence
+        collection."""
+        if not self._profiling:
+            return
+        self._profiling = False
+        self._profile_until = None
+        flushed = False
+        if flush:
+            done = threading.Event()
+
+            def _flush():
+                try:
+                    jax.profiler.stop_trace()
+                    done.set()
+                except Exception as e:  # noqa: BLE001 - the abort
+                    # proceeds regardless; an unreadable trace is
+                    # journaled below
+                    self.log(f"profiler: abort flush failed: {e}")
+
+            ft = threading.Thread(target=_flush, daemon=True)
+            ft.start()
+            ft.join(timeout=flush_timeout_s)
+            flushed = done.is_set()
+            if flushed:
+                self.log(f"profiler: trace flushed on abort ({reason})")
+            elif ft.is_alive():
+                self.log("profiler: abort flush did not finish in "
+                         f"{flush_timeout_s:.0f}s; trace may be torn")
+        if self.telemetry is not None:
+            self.telemetry.emit("profile_aborted", reason=reason,
+                                flushed=flushed, iteration=self.iteration)
 
     # -- loop ---------------------------------------------------------------
 
@@ -1464,6 +1569,16 @@ class TrainLoop:
         with DistributedSignalHandler() as sig, contextlib.ExitStack() as _s:
             _s.callback(self._profile_stop)
             _s.callback(self._close_prefetcher)
+            if threading.current_thread() is threading.main_thread():
+                # SIGUSR1 = on-demand profile window (the handler only
+                # sets a flag; _profile_window opens the trace at the
+                # next pass, off signal context)
+                prev_usr1 = signal_module.signal(
+                    signal_module.SIGUSR1,
+                    lambda s, f: setattr(self, "_profile_signal_pending",
+                                         True))
+                _s.callback(signal_module.signal,
+                            signal_module.SIGUSR1, prev_usr1)
             if t.step_timeout_s:
                 # hang sentinel: deadline clock starts at the FIRST
                 # processed step, so the initial compile is exempt

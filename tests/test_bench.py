@@ -346,3 +346,32 @@ def test_bench_quick_mode(monkeypatch):
         bench.main()
     out = json.loads(buf.getvalue().strip())
     assert len(out["detail"]["sweep"]) == 1
+
+
+def test_bench_profile_dir_attaches_trace_split(monkeypatch, tmp_path):
+    """ISSUE 13: with MEGATRON_TPU_PROFILE_DIR set, the headline detail
+    carries the comm/compute/exposed split decoded from the re-run's
+    xplane trace — the chip-window capture recipe leaves the Flash-
+    Communication numbers in the round's record automatically."""
+    import bench
+    from megatron_tpu.models import presets
+
+    monkeypatch.setenv("MEGATRON_TPU_BENCH_QUICK", "1")
+    monkeypatch.setenv("MEGATRON_TPU_PROFILE_DIR",
+                       str(tmp_path / "prof"))
+    monkeypatch.setattr(bench, "headline_config",
+                        lambda seq_length=2048: presets.tiny(
+                            vocab_size=128, seq_length=64, hidden_size=32,
+                            num_layers=2, num_attention_heads=4,
+                            num_kv_heads=2, ffn_hidden_size=64,
+                            params_dtype="float32"))
+    monkeypatch.setattr(bench, "CANDIDATES", (
+        dict(micro_bs=2, granularity="selective", ce_chunk=0),))
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        bench.main()
+    out = json.loads(buf.getvalue().strip())
+    split = out["detail"]["trace_split"]
+    assert split["busy_s"]["compute"] > 0
+    assert split["module"]  # the jitted step dominated the trace
+    assert "collectives" in split and "exposed_collective_s" in split

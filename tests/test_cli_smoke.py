@@ -1,0 +1,67 @@
+"""CLI entry-point smoke tests (ISSUE 13 satellite).
+
+The tools/ CLIs are the operational face of the analysis subsystems —
+and the only consumers of some code paths (argparse wiring, by-path
+module loading). In-process tests import their modules, which can keep
+passing while the actual ``python tools/X.py`` invocation rots (a bad
+shebang-era import, a renamed flag, a sys.path assumption). Each runs
+here as a REAL subprocess, the way an operator runs it.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO, "tests", "fixtures", "tiny_cpu.xplane.pb")
+
+
+def _run(args, timeout=240, env_extra=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.update(env_extra or {})
+    return subprocess.run([sys.executable] + args, cwd=REPO,
+                         capture_output=True, text=True, timeout=timeout,
+                         env=env)
+
+
+def test_jaxlint_cli_clean_at_head():
+    out = _run([os.path.join("tools", "jaxlint.py")])
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_comm_report_cli_check():
+    # one cheap config keeps the smoke fast; the full matrix is gated
+    # in-process by tests/test_analysis.py
+    out = _run([os.path.join("tools", "comm_report.py"), "--check",
+                "--config", "ulysses_cp2"],
+               env_extra={"XLA_FLAGS":
+                          "--xla_force_host_platform_device_count=8"})
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "comm contracts: OK" in out.stdout
+
+
+def test_trace_report_cli_help_and_fixture():
+    out = _run([os.path.join("tools", "trace_report.py"), "--help"])
+    assert out.returncode == 0, out.stderr
+    assert "xplane" in out.stdout
+    # and a real parse through the subprocess entry point
+    out = _run([os.path.join("tools", "trace_report.py"), FIXTURE,
+                "--format", "json"])
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert json.loads(out.stdout)["report"]["module"] == "jit_fixture_step"
+
+
+def test_telemetry_report_cli_help():
+    out = _run([os.path.join("tools", "telemetry_report.py"), "--help"])
+    assert out.returncode == 0, out.stderr
+    assert "--perfetto" in out.stdout
+
+
+@pytest.mark.parametrize("missing", ["/nonexistent/trace/dir"])
+def test_trace_report_cli_missing_input_is_rc1(missing):
+    out = _run([os.path.join("tools", "trace_report.py"), missing])
+    assert out.returncode == 1
+    assert "no *.xplane.pb" in out.stderr

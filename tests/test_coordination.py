@@ -741,6 +741,33 @@ def test_sigterm_one_host_drains_both(tmp_path, corpus):
         assert run_end["received_signal"] == "SIGTERM"
     assert pres[0]["iteration"] == pres[1]["iteration"] == trackers[0]
 
+    # --perfetto round-trip (ISSUE 13): BOTH hosts' real journals render
+    # as one schema-valid timeline — two host processes, step spans, and
+    # the cluster preemption visible as an instant on each
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import telemetry_report
+    finally:
+        sys.path.pop(0)
+    from test_telemetry import validate_trace_events
+
+    out_json = os.path.join(base, "cluster.perfetto.json")
+    trace = telemetry_report.write_perfetto(
+        [os.path.join(base, f"tele{h}", "events.jsonl")
+         for h in range(2)], out_json)
+    assert validate_trace_events(trace)
+    assert os.path.exists(out_json)
+    procs = {e["args"]["name"] for e in trace["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert any("host 0" in p for p in procs)
+    assert any("host 1" in p for p in procs)
+    assert {e["pid"] for e in trace["traceEvents"]} == {0, 1}
+    for pid in (0, 1):
+        assert any(e["ph"] == "X" and e["name"].startswith("step ")
+                   and e["pid"] == pid for e in trace["traceEvents"])
+        assert any(e["ph"] == "i" and e["name"] == "preemption"
+                   and e["pid"] == pid for e in trace["traceEvents"])
+
 
 def test_sigkill_one_host_peer_abort_within_timeout(tmp_path, corpus):
     """Acceptance (ISSUE 12): SIGKILL of one host mid-run → the survivor

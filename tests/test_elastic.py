@@ -71,7 +71,7 @@ def test_elastic_resume_microbatch_change_sample_exact(tmp_path):
 
     save = str(tmp_path / "ckpt")
 
-    def run(tele, micro, iters, load=False, fault=None):
+    def run(tele, micro, iters, load=False, fault=None, profile=False):
         os.environ.pop(resilience.FAULT_ENV, None)
         if fault:
             os.environ[resilience.FAULT_ENV] = fault
@@ -89,6 +89,11 @@ def test_elastic_resume_microbatch_change_sample_exact(tmp_path):
                     save=(save if load or fault else None),
                     load=(save if load else None),
                     telemetry_dir=str(tele), log_data_fingerprint=True,
+                    # a window deliberately left OPEN across the preempt
+                    # iteration: the expedited path must flush it
+                    profile=profile, profile_step_start=2,
+                    profile_step_end=1 << 30,
+                    profile_dir=str(tele / "trace"),
                     preempt_save_timeout=120.0))
             loop = TrainLoop(cfg, log=lambda m: None)
             loop.train(factory)
@@ -99,12 +104,23 @@ def test_elastic_resume_microbatch_change_sample_exact(tmp_path):
     # oracle: uninterrupted at micro_batch=2
     _, oracle = run(tmp_path / "oracle", micro=2, iters=8)
     assert set(oracle) == set(range(1, 9))
-    # preempted at iteration 4 (SIGTERM notice -> committed checkpoint)
-    _, pre = run(tmp_path / "pre", micro=2, iters=8, fault="preempt_at:4")
+    # preempted at iteration 4 (SIGTERM notice -> committed checkpoint),
+    # with a --profile window still open when the notice lands
+    evs_pre, pre = run(tmp_path / "pre", micro=2, iters=8,
+                       fault="preempt_at:4", profile=True)
     assert max(pre) == 4
     from megatron_tpu.training import checkpointing
 
     assert checkpointing.read_tracker(save) == 4
+    # the expedited path closed the trace BEFORE spending grace on the
+    # save: journaled as an abort-with-flush, and the file is readable
+    aborted = [e for e in evs_pre if e["kind"] == "profile_aborted"]
+    assert len(aborted) == 1
+    assert aborted[0]["reason"] == "preemption"
+    assert aborted[0]["flushed"] is True
+    from megatron_tpu.telemetry.tracing import find_xplane_files
+
+    assert find_xplane_files(str(tmp_path / "pre" / "trace"))
     # resume at micro_batch=1: accumulation 2 -> 4, same global batch
     evs, res = run(tmp_path / "res", micro=1, iters=8, load=True)
     elastic = [e for e in evs if e["kind"] == "elastic_resume"]

@@ -76,6 +76,63 @@ def test_scrape_roundtrips_registry_exposition():
     assert labels == {"p": "C:\\new", "q": "a\nb"}
 
 
+def test_strict_scrape_roundtrips_every_family(tmp_path):
+    """ISSUE 13 satellite: the registry's exposition round-trips through
+    parse_prom_text(strict=True) — every family declared by # HELP +
+    # TYPE, label values with every legal escape surviving byte-exact,
+    HELP text escaped symmetrically — and format violations raise
+    instead of silently dropping series."""
+    reg = MetricsRegistry()
+    nasty = 'quo"te\nnew\\line\\nliteral'
+    help_nasty = "first line\nsecond \\ line"
+    c = reg.counter("requests_total", help_nasty, label_names=("path",))
+    c.inc(path=nasty)
+    c.inc(path="plain")
+    reg.gauge("depth", "").set(7)  # empty help still gets a HELP line
+    h = reg.histogram("latency_seconds", "lat")
+    h.observe(0.3)
+    text = reg.render()
+
+    samples = scrape.parse_prom_text(text, strict=True)
+    assert scrape.sample_value(samples, "requests_total", path=nasty) == 1
+    assert scrape.sample_value(samples, "requests_total",
+                               path="plain") == 1
+    assert scrape.sample_value(samples, "depth") == 7
+    assert scrape.histogram_percentile(samples, "latency_seconds",
+                                       0.5) == h.percentile(0.5)
+
+    meta = scrape.parse_prom_metadata(text)
+    assert meta["requests_total"] == {"help": help_nasty,
+                                      "type": "counter"}
+    assert meta["depth"]["type"] == "gauge"
+    assert meta["depth"]["help"]  # non-empty fallback
+    assert meta["latency_seconds"]["type"] == "histogram"
+    # every sample family is declared (the strict parse above proved it;
+    # cross-check: no family without both comment lines)
+    for family in samples:
+        base = family
+        for suffix in ("_bucket", "_sum", "_count"):
+            if family.endswith(suffix) and family[:-len(suffix)] in meta:
+                base = family[:-len(suffix)]
+        assert set(meta[base]) == {"help", "type"}, family
+
+    # violations raise in strict mode (and only there)
+    for bad in (
+            "garbage line here",
+            "undeclared_metric 1",
+            "# TYPE m counter\nm{x=\"a\\qb\"} 1",   # illegal escape
+            "# TYPE m counter\nm{x=\"a\" junk} 1",  # malformed labels
+            "# TYPE m counter\nm not_a_number",
+            "# TYPE m counter\n# TYPE m gauge\nm 1"):
+        with pytest.raises(scrape.ScrapeFormatError):
+            scrape.parse_prom_text(bad, strict=True)
+        scrape.parse_prom_text(bad)  # lenient mode shrugs
+    # lenient mode keeps a third-party exposition's unknown escape
+    # VERBATIM — the label value must not silently lose its backslash
+    lenient = scrape.parse_prom_text(r'm{x="a\tb"} 1')
+    assert lenient["m"][0][0] == {"x": r"a\tb"}
+
+
 def test_scrape_diff_and_merge():
     reg = MetricsRegistry()
     h = reg.histogram("engine_ttft_seconds", "ttft")
@@ -623,6 +680,72 @@ def test_hot_reload_over_http(fleet_service, tmp_path):
                        {"load": str(tmp_path / "missing")})
     assert code == 409
     assert _get(url, "/admin/status")[1]["weights_version"] == 3
+
+
+def test_admin_profile_captures_under_live_traffic(fleet_service,
+                                                   tmp_path):
+    """POST /admin/profile traces N decode ticks under live traffic
+    without a restart: the capture brackets the step loop from the admin
+    thread (no per-tick check, no extra traced args), so it costs zero
+    decode recompiles, the trace is readable by tools/trace_report.py,
+    and begin/end land in the journal."""
+    from megatron_tpu.inference import engine as engine_mod
+    from megatron_tpu.telemetry.journal import (
+        EventJournal, set_global_journal,
+    )
+    from megatron_tpu.telemetry.tracing import (
+        analyze_events, classify_xspace, find_xplane_files, load_xspace,
+    )
+
+    svc, url = fleet_service
+    svc.warmup()
+    journal = EventJournal(str(tmp_path / "events.jsonl"))
+    set_global_journal(journal)
+    recompiles0 = svc.engine.stats["decode_recompiles"]
+    stop = threading.Event()
+    statuses = []
+
+    def traffic():
+        while not stop.is_set():
+            statuses.append(_post(url, "/api", {
+                "prompts": ["3 4 5"], "tokens_to_generate": 16})[0])
+
+    t = threading.Thread(target=traffic, daemon=True)
+    t.start()
+    try:
+        code, body = _post(url, "/admin/profile",
+                           {"steps": 3, "dir": str(tmp_path / "prof"),
+                            "timeout_s": 60})
+    finally:
+        stop.set()
+        t.join(timeout=120)
+        set_global_journal(None)
+    assert code == 200, body
+    assert body["complete"] and body["ticks"] >= 3
+    assert statuses and all(s == 200 for s in statuses)
+    # the capture cost no decode recompiles (same traced args)
+    assert svc.engine.stats["decode_recompiles"] == recompiles0
+    # the trace is a real xplane the decoder reads: the jitted decode
+    # step's op events are in it with nonzero compute time
+    files = find_xplane_files(str(tmp_path / "prof"))
+    assert files
+    events = []
+    for f in files:
+        events.extend(classify_xspace(load_xspace(f)))
+    report = analyze_events(events)
+    assert "jit_decode_step" in report.all_modules
+    assert report.compute_s > 0
+    kinds = [e["kind"] for e in journal.events()]
+    assert "profile_begin" in kinds and "profile_end" in kinds
+    journal.close()
+    # the profiler session is process-global: a concurrent second
+    # capture answers 409, not a corrupted trace
+    with engine_mod._PROFILE_LOCK:
+        code, body = _post(url, "/admin/profile",
+                           {"steps": 1, "dir": str(tmp_path / "p2")})
+        assert code == 409
+    # bad input still 400s
+    assert _post(url, "/admin/profile", {"steps": 0})[0] == 400
 
 
 @pytest.mark.slow  # 6s measured cacheless (one speculating engine

@@ -467,6 +467,179 @@ def test_train_goodput_attributes_slow_save_stall(tmp_path, corpus):
 
 
 # ---------------------------------------------------------------------------
+# perfetto timeline + --format json (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+
+def _import_telemetry_report():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import telemetry_report
+    finally:
+        sys.path.pop(0)
+    return telemetry_report
+
+
+def validate_trace_events(trace):
+    """Strict structural check against the Chrome trace-event JSON
+     schema (the subset the converter emits): ``traceEvents`` list where
+    every event has a phase, pid and microsecond timestamp; complete
+    events carry a duration, metadata events carry args.name. Shared
+    with test_coordination's multi-host round-trip."""
+    assert isinstance(trace, dict)
+    assert isinstance(trace["traceEvents"], list)
+    assert trace.get("displayTimeUnit") in ("ms", "ns")
+    for ev in trace["traceEvents"]:
+        assert ev["ph"] in ("X", "i", "M"), ev
+        assert isinstance(ev["pid"], int), ev
+        assert isinstance(ev["name"], str) and ev["name"], ev
+        assert isinstance(ev["ts"], (int, float)), ev
+        if ev["ph"] == "X":
+            assert isinstance(ev["dur"], (int, float)) and ev["dur"] >= 0
+            assert isinstance(ev["tid"], int)
+            assert ev["ts"] >= 0
+        elif ev["ph"] == "i":
+            assert ev["s"] in ("g", "p", "t")
+            assert isinstance(ev["tid"], int)
+        elif ev["ph"] == "M":
+            assert ev["name"] in ("process_name", "thread_name")
+            assert isinstance(ev["args"]["name"], str)
+    return True
+
+
+def test_perfetto_converter_lanes_and_schema(tmp_path):
+    """Journal -> trace events: steps/data-waits/checkpoints/serve
+    requests/profile windows become complete spans drawn back from
+    their completion timestamps, incidents become instants, and the
+    whole object validates against the trace-event schema."""
+    from megatron_tpu.telemetry.perfetto import journals_to_trace_events
+
+    t0 = 1000.0
+    events = [
+        {"ts": t0, "kind": "run_start", "iteration": 0, "host": 3},
+        {"ts": t0 + 1.0, "kind": "step", "iteration": 1, "step_ms": 100.0,
+         "data_wait_ms": 20.0, "loss": 2.5},
+        {"ts": t0 + 1.5, "kind": "checkpoint_begin", "iteration": 1},
+        {"ts": t0 + 2.0, "kind": "checkpoint_commit", "iteration": 1,
+         "seconds": 0.4},
+        {"ts": t0 + 2.1, "kind": "checkpoint_stall", "iteration": 1,
+         "seconds": 0.1},
+        {"ts": t0 + 2.5, "kind": "eval", "seconds": 0.2},
+        {"ts": t0 + 3.0, "kind": "serve_request", "status": "ok",
+         "wall_s": 0.8, "ttft_s": 0.1},
+        {"ts": t0 + 3.2, "kind": "profile_begin", "iteration": 2,
+         "until": 4, "dir": "/t", "source": "SIGUSR1"},
+        {"ts": t0 + 3.9, "kind": "profile_end", "iteration": 4},
+        {"ts": t0 + 4.0, "kind": "preemption", "iteration": 4,
+         "notice_host": 3},
+        {"ts": t0 + 4.1, "kind": "profile_begin", "iteration": 5,
+         "until": 7, "dir": "/t", "source": "--profile"},
+        {"ts": t0 + 4.2, "kind": "profile_aborted", "reason": "hang",
+         "flushed": True},
+        {"ts": t0 + 4.3, "kind": "profile_begin", "iteration": 8,
+         "until": 9, "dir": "/t", "source": "--profile"},
+    ]
+    trace = journals_to_trace_events([("h3/events.jsonl", events)])
+    assert validate_trace_events(trace)
+    evs = trace["traceEvents"]
+    # pid = the coordination host id off run_start
+    assert all(e["pid"] == 3 for e in evs)
+    procs = [e for e in evs if e["ph"] == "M"
+             and e["name"] == "process_name"]
+    assert len(procs) == 1 and "host 3" in procs[0]["args"]["name"]
+
+    def lane(name):
+        [m] = [e for e in evs if e["ph"] == "M"
+               and e["name"] == "thread_name"
+               and e["args"]["name"] == name]
+        return m["tid"]
+
+    step = next(e for e in evs if e["ph"] == "X"
+                and e["name"] == "step 1")
+    assert step["dur"] == pytest.approx(100e3)       # µs
+    assert step["ts"] == pytest.approx((1.0 - 0.1) * 1e6)  # drawn back
+    assert step["tid"] == lane("train steps")
+    wait = next(e for e in evs if e["name"] == "data_wait")
+    assert wait["dur"] == pytest.approx(20e3)
+    # the wait lane precedes the step span it fed
+    assert wait["ts"] + wait["dur"] == pytest.approx(step["ts"])
+    ckpt = next(e for e in evs if e["name"] == "checkpoint 1")
+    # begin->commit pairing wins over the commit's own `seconds`
+    assert ckpt["dur"] == pytest.approx(0.5e6)
+    req = next(e for e in evs if e["name"] == "req ok")
+    assert req["dur"] == pytest.approx(0.8e6)
+    prof = next(e for e in evs if e["name"] == "profile window")
+    assert prof["dur"] == pytest.approx(0.7e6, rel=1e-3)
+    # an abort CLOSES the open window (drawn up to the abort) so later
+    # begin/end pairs aren't mis-paired across it; the last begin with
+    # no close at all renders as an unclosed instant
+    aborted = next(e for e in evs
+                   if e["name"] == "profile window (aborted)")
+    assert aborted["ph"] == "X"
+    assert aborted["dur"] == pytest.approx(0.1e6, rel=1e-3)
+    names_i = {e["name"] for e in evs if e["ph"] == "i"}
+    assert {"run_start", "preemption", "profile_aborted",
+            "profile window (unclosed)"} <= names_i
+
+
+def test_perfetto_multi_journal_pids(tmp_path):
+    from megatron_tpu.telemetry.perfetto import journals_to_trace_events
+
+    j0 = [{"ts": 1.0, "kind": "run_start", "host": 0},
+          {"ts": 2.0, "kind": "step", "iteration": 1, "step_ms": 5.0}]
+    j1 = [{"ts": 1.0, "kind": "run_start", "host": 1},
+          {"ts": 2.5, "kind": "peer_abort", "host": 0, "cause": "hang"}]
+    trace = journals_to_trace_events([("h0", j0), ("h1", j1)])
+    validate_trace_events(trace)
+    pids = {e["pid"] for e in trace["traceEvents"]}
+    assert pids == {0, 1}
+    # journals without host attribution fall back to their index,
+    # colliding pids are reassigned
+    trace2 = journals_to_trace_events([("a", j0), ("b", j0)])
+    validate_trace_events(trace2)
+    assert len({e["pid"] for e in trace2["traceEvents"]}) == 2
+
+
+def test_telemetry_report_format_json_and_perfetto_cli(tmp_path, capsys):
+    """--format json emits per-section dicts (CI consumes goodput/
+    serving numbers without scraping tables); --perfetto writes the
+    timeline file alongside."""
+    telemetry_report = _import_telemetry_report()
+    journal = tmp_path / "events.jsonl"
+    events = [
+        {"ts": 1.0, "kind": "run_start", "iteration": 0},
+        {"ts": 2.0, "kind": "step", "iteration": 1, "step_ms": 10.0,
+         "loss": 1.5, "tokens_per_s": 100.0, "data_wait_ms": 1.0},
+        {"ts": 3.0, "kind": "goodput", "wall_s": 2.0, "productive_s": 1.5},
+        {"ts": 4.0, "kind": "serve_request", "status": "ok",
+         "wall_s": 0.5, "ttft_s": 0.1},
+        {"ts": 5.0, "kind": "preemption", "iteration": 1,
+         "notice_host": 0},
+    ]
+    with open(journal, "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    out_trace = tmp_path / "trace.json"
+    rc = telemetry_report.main([str(journal), "--format", "json",
+                                "--perfetto", str(out_trace)])
+    assert rc == 0
+    sections = json.loads(capsys.readouterr().out)
+    assert sections["run"]["steps"] == 1
+    assert sections["goodput"]["goodput_pct"] == 75.0
+    assert sections["steps"]["step_ms"]["p50"] == 10.0
+    assert sections["serving"]["requests"]["total"] == 1
+    assert sections["resilience"]["preemptions"] == 1
+    trace = json.loads(out_trace.read_text())
+    assert validate_trace_events(trace)
+    assert any(e["name"] == "step 1" for e in trace["traceEvents"])
+    # legacy --json still prints the flat summary
+    rc = telemetry_report.main([str(journal), "--json"])
+    assert rc == 0
+    flat = json.loads(capsys.readouterr().out)
+    assert flat["steps"] == 1 and "goodput_pct" in flat
+
+
+# ---------------------------------------------------------------------------
 # CLI flags
 
 

@@ -106,6 +106,10 @@ def extra_args(parser):
                    help="compile the decode step before /readyz goes "
                         "green, so a fleet router or k8s-style prober "
                         "never routes a request into the warmup compile")
+    g.add_argument("--serve_profile_dir", default=None,
+                   help="output dir for POST /admin/profile on-demand "
+                        "captures (default runs/serve_profile); read the "
+                        "result with tools/trace_report.py")
     g.add_argument("--kv_cache_int8", action="store_true",
                    help="serve with an int8-quantized KV cache (half the "
                         "cache HBM -> 2x context/batch per chip)")
@@ -265,7 +269,8 @@ def main(argv=None):
                weights_version=weights_version,
                speculative=args.serve_speculative,
                spec_k=args.serve_spec_k,
-               draft_cfg=draft_cfg, draft_params=draft_params)
+               draft_cfg=draft_cfg, draft_params=draft_params,
+               profile_dir=args.serve_profile_dir)
 
 
 if __name__ == "__main__":

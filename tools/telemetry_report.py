@@ -3,8 +3,11 @@
 
     python tools/telemetry_report.py runs/tele/events.jsonl
     python tools/telemetry_report.py runs/tele            # dir => events.jsonl
-    python tools/telemetry_report.py runs/tele --json     # machine-readable
+    python tools/telemetry_report.py runs/tele --format json  # per-section
     python tools/telemetry_report.py host0/tele host1/tele   # multi-host
+    python tools/telemetry_report.py host*/tele --perfetto run.json
+                                  # -> one Perfetto/chrome://tracing
+                                  #    timeline of the whole cluster
 
 Several journals (one per host of a coordinated multi-host run) merge
 into ONE report: events are attributed to the host recorded on each
@@ -304,6 +307,50 @@ def _summarize_serving(events: List[Dict[str, Any]]
     return out
 
 
+#: --format json layout: section -> the summary keys it owns. CI and
+#: bench tooling key off the section names, not the text tables.
+SECTIONS = {
+    "run": ("events", "steps", "checkpoints", "process_segments"),
+    "goodput": ("goodput_pct", "wall_s", "split_s"),
+    "steps": ("step_ms", "tokens_per_s", "data_wait_ms", "last_loss",
+              "step_compiles", "compile_cache_hits"),
+    "stalls": ("stall_top",),
+    "resilience": ("faults", "divergences", "preemptions",
+                   "preemption_timeouts", "hangs", "sdc_detected",
+                   "elastic_resumes"),
+    "serving": ("serving",),
+    "coordination": ("coordination",),
+}
+
+
+def to_sections(summary: Dict[str, Any]) -> Dict[str, Any]:
+    """The per-section view --format json emits: every summary key
+    grouped under a stable section name, empty sections dropped."""
+    out: Dict[str, Any] = {}
+    for section, keys in SECTIONS.items():
+        body: Dict[str, Any] = {}
+        for key in keys:
+            if key in ("serving", "coordination"):
+                body.update(summary.get(key) or {})
+            elif summary.get(key) not in (None, [], {}):
+                body[key] = summary[key]
+        if body:
+            out[section] = body
+    return out
+
+
+def write_perfetto(paths: List[str], out_path: str) -> Dict[str, Any]:
+    """Render one Perfetto-loadable timeline from N per-host journals
+    (megatron_tpu/telemetry/perfetto.py; docs/observability.md)."""
+    from megatron_tpu.telemetry.perfetto import journals_to_trace_events
+
+    trace = journals_to_trace_events(
+        [(path, load_journal(path)) for path in paths])
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump(trace, f, separators=(",", ":"))
+    return trace
+
+
 def render(summary: Dict[str, Any]) -> str:
     lines = [f"journal: {summary['events']} events, "
              f"{summary['steps']} steps, "
@@ -410,12 +457,31 @@ def main(argv=None) -> int:
                     help="journal file(s) or telemetry dir(s) — pass one "
                          "per host for a merged multi-host report")
     ap.add_argument("--json", action="store_true",
-                    help="emit the summary as one JSON object")
+                    help="emit the flat summary as one JSON object "
+                         "(legacy; prefer --format json)")
+    ap.add_argument("--format", choices=("text", "json"), default="text",
+                    help="json = machine-readable per-section dicts "
+                         "(run/goodput/steps/stalls/resilience/serving/"
+                         "coordination) for CI and bench tooling")
+    ap.add_argument("--perfetto", metavar="OUT.json", default=None,
+                    help="also write the journals as ONE Chrome "
+                         "trace-event timeline (load at "
+                         "https://ui.perfetto.dev)")
     ap.add_argument("--top", type=int, default=5,
                     help="entries in the stall top-list")
     args = ap.parse_args(argv)
     summary = summarize(load_journals(args.journal), top_n=args.top)
-    print(json.dumps(summary, indent=1) if args.json else render(summary))
+    if args.perfetto:
+        trace = write_perfetto(args.journal, args.perfetto)
+        print(f"# perfetto: wrote {len(trace['traceEvents'])} trace "
+              f"events for {len(args.journal)} journal(s) to "
+              f"{args.perfetto}", file=sys.stderr)
+    if args.json:
+        print(json.dumps(summary, indent=1))
+    elif args.format == "json":
+        print(json.dumps(to_sections(summary), indent=1))
+    else:
+        print(render(summary))
     return 0
 
 
