@@ -91,7 +91,28 @@ CONFIGS: Dict[str, Callable[[], Any]] = {
     # table indirection (one table addresses both pools)
     "decode_spec_paged": lambda: _targets().spec_paged_decode_step_target(
         "decode_spec_paged"),
+    # serving decode step on a tp=2 mesh with EXPLICIT collectives
+    # (quant/collectives.py): the dense baseline ledger — per-layer
+    # attn_out/mlp_out psum + the vocab-parallel logits all_gather —
+    # that the compressed configs diff against (>= 3x wire-byte
+    # reduction, asserted by tools/comm_report.py --check)
+    "decode_tp2_dense": lambda: _targets().tp_decode_step_target(
+        "decode_tp2_dense", mode="dense"),
+    # the same step with int8 compressed collectives: all_to_all +
+    # all_gather moving int8 payloads with fp32 scales riding alongside
+    "decode_tp2_int8": lambda: _targets().tp_decode_step_target(
+        "decode_tp2_int8", mode="int8"),
+    # fp8(e4m3) transport variant of the same step
+    "decode_tp2_fp8": lambda: _targets().tp_decode_step_target(
+        "decode_tp2_fp8", mode="fp8"),
 }
+
+#: the compressed-vs-dense pairs --check verifies the wire-byte
+#: reduction over (compressed config, dense baseline, minimum ratio)
+COMPRESSION_GATES = (
+    ("decode_tp2_int8", "decode_tp2_dense", 3.0),
+    ("decode_tp2_fp8", "decode_tp2_dense", 3.0),
+)
 
 
 def manifest_path(name: str) -> Path:
@@ -121,6 +142,7 @@ def build_manifest(name: str, include_hlo: bool = True,
         "jaxpr": {
             "collectives": report.collective_summary(),
             "total_collective_bytes": report.total_collective_bytes(),
+            "total_wire_bytes": report.total_wire_bytes(),
             "host_callbacks": len(report.callbacks),
             "scalar_carries_in_shard_map": len(report.scalar_carries),
             "manual_axis_constraints": len(report.manual_constraints),
@@ -199,4 +221,44 @@ def check_contract(name: str, level: str = "jaxpr",
             problems += diff_section(golden["hlo"]["collectives"],
                                      fresh["hlo"]["collectives"],
                                      f"{name}/hlo")
+    return problems
+
+
+def compression_ratio(compressed: Dict[str, Any],
+                      dense: Dict[str, Any]) -> float:
+    """dense / compressed wire-byte ratio between two manifests — the
+    contract-verified byte reduction (>= the COMPRESSION_GATES floor
+    for the shipped configs). Falls back to payload bytes for pre-wire
+    manifests."""
+    def wire(m):
+        j = m.get("jaxpr", {})
+        return j.get("total_wire_bytes", j.get("total_collective_bytes", 0))
+
+    c = wire(compressed)
+    if c <= 0:
+        return 0.0
+    return wire(dense) / c
+
+
+def check_compression_gates(
+        fresh: Optional[Dict[str, Dict[str, Any]]] = None) -> List[str]:
+    """Verify every COMPRESSION_GATES pair holds (golden manifests, or
+    freshly-built ones passed as {name: manifest}). A silent revert of
+    the compressed path to dense transport (int8 bytes back to f32)
+    collapses the ratio and fails here — the injected-regression test
+    drives exactly that."""
+    problems: List[str] = []
+    for comp_name, dense_name, floor in COMPRESSION_GATES:
+        try:
+            comp = (fresh or {}).get(comp_name) or load_manifest(comp_name)
+            dense = (fresh or {}).get(dense_name) or load_manifest(dense_name)
+        except FileNotFoundError as e:
+            problems.append(f"compression gate {comp_name}: {e}")
+            continue
+        ratio = compression_ratio(comp, dense)
+        if ratio < floor:
+            problems.append(
+                f"compression gate: {comp_name} wire bytes are only "
+                f"{ratio:.2f}x below {dense_name} (floor {floor}x) — "
+                "the compressed path is moving dense-sized payloads")
     return problems

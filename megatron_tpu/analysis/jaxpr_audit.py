@@ -43,7 +43,7 @@ import jax
 # because the audit API predates the split.
 from megatron_tpu.analysis.taxonomy import (  # noqa: F401
     CALLBACK_PRIMITIVES, COLLECTIVE_PRIMITIVES, HLO_COLLECTIVE_OPS,
-    HLO_DTYPE_BITS,
+    HLO_DTYPE_BITS, is_low_bit_dtype, wire_bytes_per_call,
 )
 
 
@@ -57,12 +57,26 @@ class CollectiveOp:
     calls: int              # static count (scan trip counts multiplied in)
     context: str            # e.g. "shard_map/scan"
     in_while: bool = False  # trip count unknown => calls is per-iteration
+    axis_size: int = 0      # participating devices (0 = unknown mesh)
 
     @property
     def key(self) -> str:
         shape = "x".join(map(str, self.shape))
         return (f"{self.primitive}[{','.join(self.axes)}] "
                 f"{self.dtype}[{shape}] @{self.context}")
+
+    @property
+    def compressed(self) -> bool:
+        """Low-bit transport (the quant/ pattern): the payload rides as
+        int8/uint8/fp8, not bf16/f32."""
+        return is_low_bit_dtype(self.dtype)
+
+    @property
+    def wire_bytes(self) -> int:
+        """Estimated interconnect bytes per call (taxonomy wire model —
+        an all-reduce moves ~2x its payload, a gather (n-1)/n of it)."""
+        return wire_bytes_per_call(self.primitive, self.bytes_per_call,
+                                   self.axis_size)
 
 
 @dataclasses.dataclass
@@ -107,18 +121,31 @@ class AuditReport:
 
     def collective_summary(self) -> Dict[str, Dict[str, int]]:
         """Aggregate by CollectiveOp.key -> {count, bytes_per_call,
-        total_bytes} (the golden-manifest payload)."""
+        total_bytes, wire_bytes_per_call, total_wire_bytes, compressed}
+        (the golden-manifest payload). ``compressed`` marks low-bit
+        transport; wire bytes use the taxonomy interconnect model."""
         out: Dict[str, Dict[str, int]] = {}
         for c in self.collectives:
-            e = out.setdefault(c.key, {"count": 0,
-                                       "bytes_per_call": c.bytes_per_call,
-                                       "total_bytes": 0})
+            e = out.setdefault(c.key, {
+                "count": 0,
+                "bytes_per_call": c.bytes_per_call,
+                "total_bytes": 0,
+                "wire_bytes_per_call": c.wire_bytes,
+                "total_wire_bytes": 0,
+                "compressed": c.compressed,
+            })
             e["count"] += c.calls
             e["total_bytes"] += c.calls * c.bytes_per_call
+            e["total_wire_bytes"] += c.calls * c.wire_bytes
         return dict(sorted(out.items()))
 
     def total_collective_bytes(self) -> int:
         return sum(c.calls * c.bytes_per_call for c in self.collectives)
+
+    def total_wire_bytes(self) -> int:
+        """Estimated interconnect bytes of one program execution — the
+        number the compressed-vs-dense contract ratio is taken over."""
+        return sum(c.calls * c.wire_bytes for c in self.collectives)
 
 
 def _aval_bytes(aval) -> int:
@@ -173,12 +200,30 @@ def _subjaxprs(params) -> List[Tuple[str, Any]]:
 class _Ctx:
     multiplier: int = 1
     manual_axes: Tuple[str, ...] = ()
+    axis_sizes: Optional[Dict[str, int]] = None  # from enclosing shard_map
     path: str = ""
     in_while: bool = False
 
     def push(self, seg: str, **kw) -> "_Ctx":
         return dataclasses.replace(
             self, path=f"{self.path}/{seg}" if self.path else seg, **kw)
+
+    def collective_axis_size(self, axes: Tuple[str, ...]) -> int:
+        """Devices participating in a collective over `axes`: the
+        product of the enclosing mesh's sizes for them. No named axes
+        (positional-only psum) = 1 (no interconnect traffic); a named
+        axis with no known mesh = 0 (unknown — wire model falls back to
+        the payload)."""
+        if not axes:
+            return 1
+        if not self.axis_sizes:
+            return 0
+        n = 1
+        for a in axes:
+            if a not in self.axis_sizes:
+                return 0
+            n *= int(self.axis_sizes[a])
+        return n
 
 
 def audit_jaxpr(closed_jaxpr, name: str = "jaxpr",
@@ -194,16 +239,18 @@ def _walk(jaxpr, ctx: _Ctx, report: AuditReport, promo_thresh: int) -> None:
     for eqn in jaxpr.eqns:
         prim = eqn.primitive.name
         if prim in COLLECTIVE_PRIMITIVES:
+            axes = _collective_axes(eqn)
             for ov in eqn.outvars:
                 report.collectives.append(CollectiveOp(
                     primitive=prim,
-                    axes=_collective_axes(eqn),
+                    axes=axes,
                     shape=tuple(getattr(ov.aval, "shape", ())),
                     dtype=str(getattr(ov.aval, "dtype", "?")),
                     bytes_per_call=_aval_bytes(ov.aval),
                     calls=ctx.multiplier,
                     context=ctx.path or "top",
                     in_while=ctx.in_while,
+                    axis_size=ctx.collective_axis_size(axes),
                 ))
         elif prim in CALLBACK_PRIMITIVES:
             report.callbacks.append(Callback(prim, ctx.path or "top"))
@@ -214,8 +261,10 @@ def _walk(jaxpr, ctx: _Ctx, report: AuditReport, promo_thresh: int) -> None:
 
         if prim == "shard_map":
             manual = _shard_map_manual_axes(eqn)
+            sizes = _shard_map_axis_sizes(eqn)
             for pname, sub in _subjaxprs(eqn.params):
-                _walk(sub, ctx.push("shard_map", manual_axes=manual),
+                _walk(sub, ctx.push("shard_map", manual_axes=manual,
+                                    axis_sizes=sizes),
                       report, promo_thresh)
             continue
         if prim == "scan":
@@ -246,6 +295,17 @@ def _shard_map_manual_axes(eqn) -> Tuple[str, ...]:
     names = tuple(getattr(mesh, "axis_names", ()) or ())
     auto = set(_axis_tuple(eqn.params.get("auto")))
     return tuple(n for n in names if str(n) not in auto)
+
+
+def _shard_map_axis_sizes(eqn) -> Dict[str, int]:
+    """axis name -> size from the shard_map's (abstract) mesh, for the
+    wire-byte model."""
+    mesh = eqn.params.get("mesh")
+    shape = getattr(mesh, "shape", None)
+    try:
+        return {str(k): int(v) for k, v in dict(shape or {}).items()}
+    except (TypeError, ValueError):
+        return {}
 
 
 def _check_scan_carries(eqn, ctx: _Ctx, report: AuditReport) -> None:

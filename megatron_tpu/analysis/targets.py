@@ -218,6 +218,50 @@ def spec_decode_step_target(name: str = "decode_spec",
     return AuditTarget(name=name, fn=eng._spec_step, args=args)
 
 
+def tp_decode_step_target(name: str = "decode_tp2_dense",
+                          mode: str = "dense", tp: int = 2,
+                          num_slots: int = 4) -> AuditTarget:
+    """The serving engine's decode step on a tensor-parallel mesh with
+    EXPLICIT collectives (quant/collectives.py): per-layer attn_out /
+    mlp_out row-parallel reductions + the vocab-parallel logits gather
+    run as shard_map collectives the jaxpr auditor can SEE (GSPMD's
+    inserted all-reduces only exist at HLO level).
+
+    mode "dense" pins the full-precision baseline ledger; "int8"/"fp8"
+    pin the compressed transport — the manifest pair is the contract-
+    verified byte reduction (contracts.COMPRESSION_GATES: >= 3x wire
+    bytes). Geometry stays at the pinned fp32 contract dtype, like the
+    ring/ulysses op targets: the ratio measured is f32-dense vs
+    quantized+scales at tp=2."""
+    from megatron_tpu.config import ParallelConfig
+    from megatron_tpu.inference.engine import InferenceEngine
+    from megatron_tpu.models.params import init_params, param_specs
+    from megatron_tpu.parallel.mesh import build_mesh
+    from megatron_tpu.parallel.sharding import shard_tree
+
+    cfg = tiny_model()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rt = build_mesh(ParallelConfig(tensor_parallel=tp),
+                    devices=jax.devices()[:tp])
+    sparams = shard_tree(rt, params, param_specs(cfg))
+    eng = InferenceEngine(cfg, sparams, num_slots=num_slots,
+                          max_seq_len=cfg.seq_length, mesh=rt.mesh,
+                          force_donate=True, compress_collectives=mode)
+    N = num_slots
+    args = (
+        _sds(sparams),
+        _sds(eng.caches),
+        jax.ShapeDtypeStruct((N,), jnp.int32),      # last_tok
+        jax.ShapeDtypeStruct((N,), jnp.int32),      # lengths
+        jax.ShapeDtypeStruct((N, 2), jnp.uint32),   # keys
+        jax.ShapeDtypeStruct((N,), jnp.float32),    # temps
+        jax.ShapeDtypeStruct((N,), jnp.int32),      # top_ks
+        jax.ShapeDtypeStruct((N,), jnp.float32),    # top_ps
+    )
+    return AuditTarget(name=name, fn=eng._decode_step, args=args,
+                       mesh=rt.mesh)
+
+
 def spec_paged_decode_step_target(name: str = "decode_spec_paged",
                                   dtype: str = "bfloat16",
                                   num_slots: int = 4,

@@ -53,6 +53,54 @@ HLO_DTYPE_BITS = {
     "s64": 64, "u64": 64, "f64": 64, "c64": 64, "c128": 128,
 }
 
+#: low-bit transport dtypes (jaxpr dtype strings): a collective moving
+#: one of these is the COMPRESSED pattern (megatron_tpu/quant/) — the
+#: auditor flags it so the golden manifests show int8 bytes, not bf16
+LOW_BIT_DTYPES = {
+    "int8", "uint8", "float8_e4m3fn", "float8_e4m3", "float8_e5m2",
+    "float8_e4m3fnuz", "float8_e5m2fnuz",
+}
+
+
+def is_low_bit_dtype(dtype_str: str) -> bool:
+    """True for <=8-bit collective payloads (quantized transport)."""
+    return str(dtype_str) in LOW_BIT_DTYPES
+
+
+def wire_bytes_per_call(primitive: str, payload_bytes: int,
+                        axis_size: int) -> int:
+    """Estimated per-device bytes one collective call moves over the
+    interconnect, from its (result) payload size and the participating
+    axis size n — the standard ring/bidirectional cost model:
+
+      * all-reduce (psum/pmax/pmin): 2 * payload * (n-1)/n
+        (reduce-scatter phase + all-gather phase)
+      * all-gather / all-to-all: payload * (n-1)/n received (a device
+        already holds its own shard of the result)
+      * reduce/psum_scatter: payload is the SCATTERED result, so each
+        device received (n-1) result-sized contributions
+      * ppermute / pbroadcast: the payload once
+
+    axis_size <= 1 moves nothing (including positional-axes psums, whose
+    named-axis tuple is empty). axis_size 0 = unknown (no mesh on the
+    enclosing shard_map): fall back to the payload itself rather than
+    claiming zero traffic. The SAME model prices the telemetry counters
+    (quant/collectives.forward_comm_bytes), so manifests and live
+    counters agree."""
+    if axis_size == 0:
+        return payload_bytes
+    n = int(axis_size)
+    if n <= 1:
+        return 0
+    if primitive in ("psum", "pmax", "pmin"):
+        return 2 * payload_bytes * (n - 1) // n
+    if primitive in ("all_gather", "pgather", "all_to_all",
+                     "ragged_all_to_all"):
+        return payload_bytes * (n - 1) // n
+    if primitive in ("reduce_scatter", "psum_scatter"):
+        return payload_bytes * (n - 1)
+    return payload_bytes
+
 # An HLO instruction name is the op mnemonic plus an optional
 # ``.<number>`` (or ``-start``/``-done`` async halves): the trace event
 # for GSPMD's 12th all-gather is named ``all-gather.12``.

@@ -141,7 +141,10 @@ class InferenceEngine:
                  flight_recorder=None,
                  force_donate: Optional[bool] = None,
                  max_queue: Optional[int] = None,
-                 speculative=None):
+                 speculative=None,
+                 compress_collectives: str = "none",
+                 comm_policy=None,
+                 comm_chunk: int = 32):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
         if max_queue is not None and max_queue < 1:
@@ -166,6 +169,30 @@ class InferenceEngine:
         self.vocab_size = vocab_size
         self.mesh = mesh
         self.want_logprobs = want_logprobs
+        # compressed TP collectives (quant/collectives.py,
+        # --serve_compress_collectives): replace the decode forward's
+        # tensor-parallel output reductions + logits gather with explicit
+        # low-bit (int8/fp8) collectives. None when the flag is off or
+        # the mesh's tensor axis is trivial (dense path unchanged). The
+        # plan is STATIC at engine build — compiled into the decode
+        # step, zero traced args, zero recompiles.
+        from megatron_tpu.quant.collectives import (
+            forward_comm_bytes, make_tp_comm,
+        )
+
+        self.tp_comm = make_tp_comm(mesh, compress_collectives, cfg=cfg,
+                                    policy=comm_policy, chunk=comm_chunk)
+        if self.tp_comm is not None and speculative is not None:
+            raise ValueError(
+                "compress_collectives with speculative decoding is not "
+                "supported (the spec step is not threaded through the "
+                "explicit TP collectives) — drop one of the two")
+        # static wire-byte prices for the telemetry counters: what one
+        # decode tick moves in this mode, and what the dense path would
+        # have moved (their ratio IS the live compression ratio)
+        self._comm_tick_bytes = forward_comm_bytes(
+            cfg, self.tp_comm, num_slots, 1)
+        self._comm_prefill_bytes = {}  # bucket P -> forward bytes
 
         N = num_slots
         # committed placement for params as well as caches: random-init
@@ -175,7 +202,7 @@ class InferenceEngine:
         # random-init engine would split the decode step's jit cache key
         # and pay one recompile (the smoke test caught exactly that)
         self.params = self._commit(self.params)
-        self.caches = self._commit(self._fresh_caches())
+        self.caches = self._commit_caches(self._fresh_caches())
         # speculative decoding (inference/speculative.py): k drafted
         # tokens per slot verified by ONE [N, k+1] target forward per
         # tick, exact accept/reject inside the jitted step. The draft-
@@ -296,6 +323,20 @@ class InferenceEngine:
             "engine_spec_accept_length",
             "accepted drafts per slot per tick (0..k)",
             buckets=(0.5, 1.5, 2.5, 3.5, 4.5, 6.5, 8.5, 12.5, 16.5))
+        # compressed-collective accounting (quant/): dense = the bytes a
+        # dense TP engine would have moved for the same work, compressed
+        # = what this mode moves; dense/compressed = live compression
+        # ratio (tools/telemetry_report.py serving section)
+        self._m_comm_dense = m.counter(
+            "engine_comm_dense_bytes_total",
+            "TP-collective wire bytes the dense path would have moved")
+        self._m_comm_compressed = m.counter(
+            "engine_comm_compressed_bytes_total",
+            "TP-collective wire bytes actually moved by this mode")
+        if self.tp_comm is not None:
+            self.stats.update({"comm_dense_bytes": 0,
+                               "comm_compressed_bytes": 0})
+            self._journal_comm_policy()
         self._m_slots.set(num_slots)
 
     # ----- cache + shape policy -------------------------------------------
@@ -346,7 +387,7 @@ class InferenceEngine:
         """Replace every donated cache tree after a failed device call
         may have consumed the old buffers (prefill/decode failure
         recovery). Cached prefixes and draft state die with them."""
-        self.caches = self._commit(self._fresh_caches())
+        self.caches = self._commit_caches(self._fresh_caches())
         if self.draft_caches is not None:
             self.draft_caches = self._commit(self._fresh_draft_caches())
 
@@ -383,20 +424,83 @@ class InferenceEngine:
         sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
         return jax.tree.map(lambda a: jax.device_put(a, sharding), tree)
 
+    def _kv_sharding(self):
+        """Cache-leaf placement on a mesh engine: every cache leaf is
+        5-D with kv_heads at axis 3 (dense rows, paged pools, and their
+        int8 scale companions alike), sharded over "tensor" when it
+        divides — matching the column-parallel wk/wv head sharding so
+        cache writes stay local. None on mesh-less engines."""
+        if self.mesh is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        tp = dict(self.mesh.shape).get("tensor", 1)
+        if tp > 1 and self.cfg.n_kv_heads % tp == 0:
+            return NamedSharding(self.mesh, P(None, None, None, "tensor",
+                                              None))
+        return NamedSharding(self.mesh, P())
+
+    def _commit_caches(self, tree):
+        """Mesh engines pin the cache layout explicitly (and the decode/
+        prefill jits pin it back via out_shardings): without this the
+        first tick's host-uploaded caches and the steady state's jit
+        outputs split the decode step's cache key — the same wasted
+        compile _commit fixes for single-device engines, which mesh
+        engines used to pay (1 decode recompile after warmup)."""
+        if self.mesh is None:
+            return self._commit(tree)
+        sh = self._kv_sharding()
+        return jax.tree.map(lambda a: jax.device_put(a, sh), tree)
+
+    def _commit_small(self, tree):
+        """Committed replicated placement for the decode carry / page
+        tables / knob rows on mesh engines (single-device engines: the
+        ordinary commit)."""
+        if self.mesh is None:
+            return self._commit(tree)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        rep = NamedSharding(self.mesh, P())
+        return jax.tree.map(lambda a: jax.device_put(a, rep), tree)
+
+    def _jit_sharding_kwargs(self, out_template):
+        """out_shardings kwargs for the decode/prefill jits on a mesh
+        engine: "kv" entries take the pinned cache sharding, everything
+        else replicated — so outputs re-enter the next call with
+        byte-identical signatures (zero steady-state recompiles). {} on
+        mesh-less engines (placement matches _commit already)."""
+        if self.mesh is None:
+            return {}
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        rep = NamedSharding(self.mesh, P())
+        kv = self._kv_sharding()
+
+        def resolve(tag):
+            if tag == "kv":
+                return jax.tree.map(lambda _: kv, self.caches)
+            return rep
+
+        return {"out_shardings": tuple(resolve(t) for t in out_template)}
+
     def _build_decode_step(self):
         cfg, vocab, wlp = self.cfg, self.vocab_size, self.want_logprobs
+        tp_comm = self.tp_comm
         from functools import partial
 
         from megatron_tpu.models.language_model import lm_forward
 
-        @partial(jax.jit, donate_argnums=self._donate())
+        @partial(jax.jit, donate_argnums=self._donate(),
+                 **self._jit_sharding_kwargs(
+                     ("rep", "rep", "kv", "rep", "rep")))
         def decode_step(params, caches, last_tok, lengths, keys, temps,
                         top_ks, top_ps):
             # one batched token for every slot: write K/V at each slot's
             # own position, attend each slot's own valid prefix
             logits, caches = lm_forward(cfg, params, last_tok[:, None],
                                         kv_caches=caches,
-                                        cache_index=lengths)
+                                        cache_index=lengths,
+                                        tp_comm=tp_comm)
             logits = logits[:, 0]
             split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
             new_keys, subs = split[:, 0], split[:, 1]
@@ -476,17 +580,21 @@ class InferenceEngine:
             return fn
         cfg, int8, vocab = self.cfg, self.kv_cache_int8, self.vocab_size
         wlp = self.want_logprobs
+        tp_comm = self.tp_comm
         from functools import partial
 
         from megatron_tpu.models.language_model import lm_forward
 
-        @partial(jax.jit, donate_argnums=self._donate())
+        @partial(jax.jit, donate_argnums=self._donate(),
+                 **self._jit_sharding_kwargs(
+                     ("rep", "rep", "rep", "kv", "rep")))
         def prefill(params, caches, tokens, length, slot, key, temp,
                     top_k, top_p):
             small = _init_caches(cfg, 1, P, int8=int8)
             logits, small = lm_forward(cfg, params, tokens,
                                        positions=jnp.arange(P)[None, :],
-                                       kv_caches=small, cache_index=0)
+                                       kv_caches=small, cache_index=0,
+                                       tp_comm=tp_comm)
 
             def paste(big, sm):
                 idx = (0, slot) + (0,) * (big.ndim - 2)
@@ -710,6 +818,7 @@ class InferenceEngine:
         req.logprobs.append(float(lp))
         req.prompt_logprobs = [float(x) for x in plp[:p - 1]]
         self.stats["admitted"] += 1
+        self._count_comm_prefill(P)
         now = time.monotonic()
         req.first_token_time = now
         self._m_prefill.observe(now - t_prefill)
@@ -889,6 +998,45 @@ class InferenceEngine:
                    ticks=self.stats["ticks"], k=self.spec.k,
                    drafter=self.spec.drafter)
 
+    def _journal_comm_policy(self) -> None:
+        """One `comm_policy` record per engine build: which collectives
+        run compressed and the static per-tick wire prices — the journal
+        side of the engine_comm_*_bytes_total counters (the report
+        derives the compression ratio from either)."""
+        j = _journal.get_global_journal()
+        if j is None or self.tp_comm is None:
+            return
+        t = self._comm_tick_bytes
+        j.emit("comm_policy", mode=self.tp_comm.mode,
+               sites=sorted(self.tp_comm.sites), chunk=self.tp_comm.chunk,
+               tp=self.tp_comm.tp,
+               dense_bytes_per_tick=t["dense"],
+               compressed_bytes_per_tick=t["compressed"],
+               ratio=round(t["dense"] / max(t["compressed"], 1), 3))
+
+    def _count_comm(self, bytes_pair) -> None:
+        """Advance the compressed-collective byte counters by one
+        forward's static wire price ({"dense", "compressed"})."""
+        if self.tp_comm is None:
+            return
+        self.stats["comm_dense_bytes"] += bytes_pair["dense"]
+        self.stats["comm_compressed_bytes"] += bytes_pair["compressed"]
+        self._m_comm_dense.inc(bytes_pair["dense"])
+        self._m_comm_compressed.inc(bytes_pair["compressed"])
+
+    def _count_comm_prefill(self, P: int) -> None:
+        """Prefill-pass comm accounting at bucket length P (computed
+        once per bucket, like the jitted step itself)."""
+        if self.tp_comm is None:
+            return
+        pair = self._comm_prefill_bytes.get(P)
+        if pair is None:
+            from megatron_tpu.quant.collectives import forward_comm_bytes
+
+            pair = forward_comm_bytes(self.cfg, self.tp_comm, 1, P)
+            self._comm_prefill_bytes[P] = pair
+        self._count_comm(pair)
+
     def _decode_rows(self):
         """Slot indices the batched decode serves this tick (the paged
         engine excludes slots still mid-chunked-prefill)."""
@@ -939,7 +1087,7 @@ class InferenceEngine:
         the plain and speculative ticks (ONE layout; a carry change
         must hit both paths by construction)."""
         if self._carry is None:
-            self._carry = self._commit(
+            self._carry = self._commit_small(
                 (jnp.asarray(self.last_tok),
                  jnp.asarray(self.lengths),
                  jnp.asarray(self.keys),
@@ -1062,6 +1210,7 @@ class InferenceEngine:
         self._m_ticks.inc()
         self._m_tick.observe(time.monotonic() - t_tick)
         self._m_tokens.inc(len(active))
+        self._count_comm(self._comm_tick_bytes)
         self._track_decode_recompiles()
         if self.flight_recorder is not None:
             self.flight_recorder.heartbeat(

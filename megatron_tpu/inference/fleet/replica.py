@@ -215,6 +215,12 @@ def _build_and_serve(spec: Dict[str, Any]) -> None:
         # belongs to tools/run_text_generation_server.py)
         speculative=spec.get("speculative"),
         spec_k=int(spec.get("spec_k", 4)),
+        # compressed TP collectives (--serve_compress_collectives /
+        # --serve_comm_policy): pass through to the engine — a no-op on
+        # the tiny single-device fleet replicas, wired so a sharded
+        # replica spec serves compressed without a new entry point
+        compress_collectives=spec.get("compress_collectives", "none"),
+        comm_policy=spec.get("comm_policy"),
         port_file=spec.get("port_file"),
         reload_dir=spec.get("reload_dir") or spec.get("load"),
         weights_version=weights_version,

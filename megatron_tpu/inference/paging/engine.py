@@ -62,7 +62,10 @@ class PagedInferenceEngine(InferenceEngine):
                  flight_recorder=None,
                  force_donate: Optional[bool] = None,
                  max_queue: Optional[int] = None,
-                 speculative=None):
+                 speculative=None,
+                 compress_collectives: str = "none",
+                 comm_policy=None,
+                 comm_chunk: int = 32):
         if page_size < 1:
             raise ValueError(f"page_size must be >= 1, got {page_size}")
         if num_pages is not None and num_pages < 2:
@@ -78,7 +81,9 @@ class PagedInferenceEngine(InferenceEngine):
             kv_cache_int8=kv_cache_int8, vocab_size=vocab_size, mesh=mesh,
             want_logprobs=want_logprobs, metrics=metrics,
             flight_recorder=flight_recorder, force_donate=force_donate,
-            max_queue=max_queue, speculative=speculative)
+            max_queue=max_queue, speculative=speculative,
+            compress_collectives=compress_collectives,
+            comm_policy=comm_policy, comm_chunk=comm_chunk)
         if self.num_pages - 1 < self.max_pages:
             raise ValueError(
                 f"num_pages={self.num_pages} cannot hold even one full "
@@ -98,16 +103,28 @@ class PagedInferenceEngine(InferenceEngine):
         self._table_dirty = True
         self.prefill_queue = ChunkedPrefillQueue(self.prefill_chunk)
         self._chunk_step = self._build_chunk_step()
+        # static per-chunk wire price for the compressed-collective
+        # counters (one [1, C] forward; quant/collectives.py)
+        from megatron_tpu.quant.collectives import forward_comm_bytes
+
+        self._comm_chunk_bytes = forward_comm_bytes(
+            cfg, self.tp_comm, 1, self.prefill_chunk)
         self._draft_chunk_step = (self._build_draft_chunk_step()
                                   if self._has_draft_model() else None)
         # admission order for the preemption policy (higher = younger)
         self._admit_seq = [0] * N
         self._admit_counter = 0
+        # sliding-window release cursor: first page index of each slot
+        # NOT yet released (lengths never shrink below the committed
+        # value, so release progress is monotone — the per-tick scan
+        # starts here instead of at page 0)
+        self._window_cursor = [0] * N
 
         self.stats.update({
             "prefix_hits": 0, "prefix_misses": 0,
             "prefix_tokens_saved": 0, "prefill_tokens": 0,
             "prefill_chunks": 0, "preemptions": 0,
+            "window_pages_released": 0,
         })
         m = self.metrics
         self._m_pages_total = m.gauge("engine_pages_total",
@@ -128,6 +145,9 @@ class PagedInferenceEngine(InferenceEngine):
             "slots preempted under page-pool pressure")
         self._m_chunks = m.counter("engine_prefill_chunks_total",
                                    "chunked-prefill steps executed")
+        self._m_window_released = m.counter(
+            "engine_window_pages_released_total",
+            "pages freed from behind the sliding attention window")
         self._m_chunk = m.histogram("engine_prefill_chunk_seconds",
                                     "one prefill chunk's wall time")
         self._m_pages_total.set(self.num_pages - 1)
@@ -191,11 +211,14 @@ class PagedInferenceEngine(InferenceEngine):
 
     def _build_decode_step(self):
         cfg, vocab, wlp = self.cfg, self.vocab_size, self.want_logprobs
+        tp_comm = self.tp_comm
         from functools import partial
 
         from megatron_tpu.models.language_model import lm_forward
 
-        @partial(jax.jit, donate_argnums=self._donate())
+        @partial(jax.jit, donate_argnums=self._donate(),
+                 **self._jit_sharding_kwargs(
+                     ("rep", "rep", "kv", "rep", "rep")))
         def decode_step(params, caches, table, last_tok, lengths, keys,
                         temps, top_ks, top_ps):
             # identical to the slot decode step except K/V writes and
@@ -204,7 +227,8 @@ class PagedInferenceEngine(InferenceEngine):
             logits, caches = lm_forward(cfg, params, last_tok[:, None],
                                         kv_caches=caches,
                                         cache_index=lengths,
-                                        page_table=table)
+                                        page_table=table,
+                                        tp_comm=tp_comm)
             logits = logits[:, 0]
             split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
             new_keys, subs = split[:, 0], split[:, 1]
@@ -223,11 +247,14 @@ class PagedInferenceEngine(InferenceEngine):
     def _build_chunk_step(self):
         cfg, vocab, wlp = self.cfg, self.vocab_size, self.want_logprobs
         C = self.prefill_chunk
+        tp_comm = self.tp_comm
         from functools import partial
 
         from megatron_tpu.models.language_model import lm_forward
 
-        @partial(jax.jit, donate_argnums=self._donate())
+        @partial(jax.jit, donate_argnums=self._donate(),
+                 **self._jit_sharding_kwargs(
+                     ("rep", "rep", "rep", "kv", "rep")))
         def chunk_step(params, caches, table_row, tokens_ext, off,
                        write_start, write_end, sample_pos, key, temp,
                        top_k, top_p):
@@ -246,7 +273,8 @@ class PagedInferenceEngine(InferenceEngine):
                                         kv_caches=caches, cache_index=off,
                                         page_table=table_row,
                                         page_write_start=write_start,
-                                        page_write_end=write_end)
+                                        page_write_end=write_end,
+                                        tp_comm=tp_comm)
             if wlp:
                 lsm = jax.nn.log_softmax(logits[0].astype(jnp.float32),
                                          axis=-1)
@@ -321,6 +349,7 @@ class PagedInferenceEngine(InferenceEngine):
     def _clear_slot(self, i: int):
         self._release_slot_pages(i)
         self.prefill_queue.drop_slot(i)
+        self._window_cursor[i] = 0
         super()._clear_slot(i)
 
     # ----- admission -------------------------------------------------------
@@ -481,6 +510,7 @@ class PagedInferenceEngine(InferenceEngine):
             task.plp_parts.append(np.asarray(plp))
         self.stats["prefill_chunks"] += 1
         self.stats["prefill_tokens"] += n
+        self._count_comm(self._comm_chunk_bytes)
         self._m_chunks.inc()
         self._m_chunk.observe(time.monotonic() - t0)
         if self.flight_recorder is not None:
@@ -603,9 +633,46 @@ class PagedInferenceEngine(InferenceEngine):
 
     def _decode_extra_args(self):
         if self._table_dirty or self._device_table is None:
-            self._device_table = self._commit(jnp.asarray(self.tables))
+            self._device_table = self._commit_small(jnp.asarray(self.tables))
             self._table_dirty = False
         return (self._device_table,)
+
+    def _release_window_pages(self) -> None:
+        """Sliding-window page release (Mistral; ROADMAP item 1): pages
+        every position of which sits fully behind a slot's attention
+        window can never be attended again — the decode mask only allows
+        k_pos >= length + 1 - window and lengths never shrink below the
+        committed value (speculative rollback rolls back only
+        UNcommitted draft positions) — so the slot's reference goes back
+        to the pool and the table entry parks on scratch (reads of it
+        are exactly masked; scratch contents are finite activations, so
+        the masked scores stay well-defined). Pages the radix prefix
+        cache also holds keep their cache reference: a later request
+        sharing the prompt still hits them."""
+        window = self.cfg.sliding_window_size
+        if window is None:
+            return
+        ps = self.page_size
+        freed = 0
+        for i in self._decode_rows():
+            limit = int(self.lengths[i]) - int(window)
+            if limit < ps:
+                continue
+            # O(1) amortized: at most one page per slot newly crosses
+            # the window per tick, and the cursor never rewinds (a
+            # cleared/preempted slot resets it in _clear_slot)
+            for pg in range(self._window_cursor[i], limit // ps):
+                if self.tables[i, pg] != SCRATCH_PAGE:
+                    self.pool.release([int(self.tables[i, pg])])
+                    self.tables[i, pg] = SCRATCH_PAGE
+                    self._table_dirty = True
+                    freed += 1
+            self._window_cursor[i] = max(self._window_cursor[i],
+                                         limit // ps)
+        if freed:
+            self.stats["window_pages_released"] += freed
+            self._m_window_released.inc(freed)
+            self._m_pages_free.set(self.pool.free_pages)
 
     def step(self) -> int:
         """One engine tick: admit, run one prefill chunk, then one
@@ -619,6 +686,7 @@ class PagedInferenceEngine(InferenceEngine):
             # without this a long multi-chunk prompt would trip the
             # stalled() readiness check while prefilling normally
             self.last_progress_time = time.monotonic()
+        self._release_window_pages()
         self._ensure_decode_pages()
         return self._decode_tick() + chunked
 

@@ -111,7 +111,9 @@ class GenerationService:
                  speculative: Optional[str] = None,
                  spec_k: int = 4,
                  draft_cfg=None, draft_params=None,
-                 profile_dir: Optional[str] = None):
+                 profile_dir: Optional[str] = None,
+                 compress_collectives: str = "none",
+                 comm_policy: Optional[str] = None):
         """mesh + forward_fn serve sharded models: the mesh becomes
         ambient around generation (GSPMD handles tp/cp), forward_fn is the
         pp>1 pipelined forward (ref ForwardStep, forward_step.py:45-204).
@@ -139,7 +141,15 @@ class GenerationService:
         tick, greedy output token-identical to plain decode. "model"
         needs draft_cfg + draft_params (a small draft network with its
         own cache tree). Requests may opt out per call with
-        {"spec": false}."""
+        {"spec": false}.
+
+        compress_collectives ("none"|"int8"|"fp8";
+        --serve_compress_collectives): low-bit tensor-parallel
+        collectives in the engine decode/prefill forward (quant/,
+        docs/serving.md) — a no-op unless the mesh has a non-trivial
+        tensor axis. comm_policy: path to a site-policy JSON
+        (tools/trace_report.py --emit-comm-policy) choosing WHICH
+        collectives compress from measured exposed fractions."""
         if kv_cache_int8 and forward_fn is not None:
             # fail at construction, not as a 500 on every request — the
             # pipelined forward threads bf16 cache pairs (the same guard
@@ -207,7 +217,9 @@ class GenerationService:
                     num_pages=num_pages,
                     vocab_size=tokenizer.vocab_size, mesh=mesh,
                     metrics=self.metrics, max_queue=engine_max_queue,
-                    speculative=spec_cfg)
+                    speculative=spec_cfg,
+                    compress_collectives=compress_collectives,
+                    comm_policy=comm_policy)
             else:
                 from megatron_tpu.inference.engine import InferenceEngine
 
@@ -217,7 +229,9 @@ class GenerationService:
                     kv_cache_int8=kv_cache_int8,
                     vocab_size=tokenizer.vocab_size, mesh=mesh,
                     metrics=self.metrics, max_queue=engine_max_queue,
-                    speculative=spec_cfg)
+                    speculative=spec_cfg,
+                    compress_collectives=compress_collectives,
+                    comm_policy=comm_policy)
             self.engine.start()
         if not (warmup and self.engine is not None):
             # no deferred warmup: the first request pays the compile (the
@@ -661,7 +675,9 @@ def run_server(cfg: ModelConfig, params: Any, tokenizer,
                speculative: Optional[str] = None,
                spec_k: int = 4,
                draft_cfg=None, draft_params=None,
-               profile_dir: Optional[str] = None) -> None:
+               profile_dir: Optional[str] = None,
+               compress_collectives: str = "none",
+               comm_policy: Optional[str] = None) -> None:
     """Serve until killed. SIGTERM/SIGINT triggers a graceful drain
     (mirroring DistributedSignalHandler): stop admitting (503 +
     Retry-After), finish in-flight requests up to `drain_timeout`, then
@@ -686,7 +702,9 @@ def run_server(cfg: ModelConfig, params: Any, tokenizer,
                                 speculative=speculative, spec_k=spec_k,
                                 draft_cfg=draft_cfg,
                                 draft_params=draft_params,
-                                profile_dir=profile_dir)
+                                profile_dir=profile_dir,
+                                compress_collectives=compress_collectives,
+                                comm_policy=comm_policy)
     server = ThreadingHTTPServer((host, port), make_handler(service))
     bound_port = server.server_address[1]
     if port_file:
@@ -745,6 +763,10 @@ def run_server(cfg: ModelConfig, params: Any, tokenizer,
             + (", paged KV + prefix cache" if kv_paging else "")
             + (f", speculative ({speculative}, k={spec_k})"
                if speculative else "")
+            + (f", compressed collectives ({service.engine.tp_comm.mode}, "
+               f"sites {sorted(service.engine.tp_comm.sites)})"
+               if getattr(service.engine, "tp_comm", None) is not None
+               else "")
             if service.engine else "one-shot")
     print(f"serving generation API on http://{host}:{bound_port}/api "
           f"({mode})", flush=True)

@@ -175,13 +175,24 @@ def final_hidden_norm(cfg: ModelConfig, params: Dict[str, Any],
                         cfg.layernorm_epsilon)
 
 
-def lm_logits(cfg: ModelConfig, params: Dict[str, Any], x: jnp.ndarray) -> jnp.ndarray:
+def lm_logits(cfg: ModelConfig, params: Dict[str, Any], x: jnp.ndarray,
+              tp_comm=None) -> jnp.ndarray:
     """Project hidden states to vocab logits, tied or untied
-    (ref: parallel_lm_logits, language_model.py:24-53)."""
-    if cfg.tie_embed_logits:
-        w = deq(params["embed"]["tokens"], x.dtype)  # [V, h]
+    (ref: parallel_lm_logits, language_model.py:24-53).
+
+    tp_comm with the "logits" site enabled routes the vocab-parallel
+    gather through the explicit (optionally compressed) all_gather
+    (quant/collectives.py) instead of GSPMD's."""
+    tied = cfg.tie_embed_logits
+    w = deq(params["embed"]["tokens"] if tied else params["lm_head"]["w"],
+            x.dtype)
+    if tp_comm is not None and "logits" in tp_comm.sites:
+        from megatron_tpu.quant.collectives import vocab_parallel_logits
+
+        return vocab_parallel_logits(x, w, tp_comm, tied=tied)
+    if tied:
         return jnp.einsum("bsh,vh->bsv", x, w)
-    return jnp.einsum("bsh,hv->bsv", x, deq(params["lm_head"]["w"], x.dtype))
+    return jnp.einsum("bsh,hv->bsv", x, w)
 
 
 def lm_forward(
@@ -201,6 +212,7 @@ def lm_forward(
     page_table: Optional[jnp.ndarray] = None,      # [B, max_pages] int32
     page_write_start: Optional[jnp.ndarray] = None,
     page_write_end: Optional[jnp.ndarray] = None,
+    tp_comm=None,  # quant.TpComm: explicit/compressed TP collectives
 ):
     """Forward pass to logits.
 
@@ -262,6 +274,7 @@ def lm_forward(
             page_table=page_table,
             page_write_start=page_write_start,
             page_write_end=page_write_end,
+            tp_comm=tp_comm,
         )
         return (y, aux + moe_aux), new_cache
 
@@ -278,7 +291,7 @@ def lm_forward(
         # must not silently drop the router losses
         return (x, moe_aux) if return_moe_aux else x
 
-    logits = lm_logits(cfg, params, x)
+    logits = lm_logits(cfg, params, x, tp_comm=tp_comm)
     logits = sharder(logits, "logits")
     if return_moe_aux and kv_caches is not None:
         raise ValueError("return_moe_aux with kv_caches is ambiguous — "

@@ -67,8 +67,14 @@ def attention_block(
     page_table: Optional[jnp.ndarray] = None,    # [B, max_pages] int32
     page_write_start: Optional[jnp.ndarray] = None,  # scalar int32
     page_write_end: Optional[jnp.ndarray] = None,    # scalar int32
+    tp_comm=None,  # quant.TpComm: explicit/compressed TP collectives
 ) -> Tuple[jnp.ndarray, Optional[Tuple[jnp.ndarray, jnp.ndarray]]]:
     """Returns (out [B,S,h], updated kv_cache).
+
+    tp_comm (serving, quant/collectives.py): route the row-parallel
+    output projection through an explicit shard_map collective — dense
+    psum or the compressed (int8/fp8) two-step — instead of GSPMD's
+    inserted all-reduce. None = the GSPMD path, unchanged.
 
     page_table: the cache tuple holds PAGED pools [num_pages, page_size,
     nkv, D] (inference/paging/) instead of dense [B, S, nkv, D] buffers;
@@ -280,29 +286,47 @@ def attention_block(
         kv_lengths=kv_lengths,
         page_table=page_table,
     )
-    out = maybe_fp8_matmul(cfg, ctx.reshape(b, s, nq * D),
-                           deq(p["wo"], ctx.dtype))
+    if tp_comm is not None and "attn_out" in tp_comm.sites:
+        # explicit row-parallel reduction (dense psum or the compressed
+        # quantize->all_to_all->reduce->all_gather; quant/collectives.py)
+        from megatron_tpu.quant.collectives import row_parallel_matmul
+
+        out = row_parallel_matmul(ctx.reshape(b, s, nq * D),
+                                  deq(p["wo"], ctx.dtype), tp_comm,
+                                  "attn_out")
+    else:
+        out = maybe_fp8_matmul(cfg, ctx.reshape(b, s, nq * D),
+                               deq(p["wo"], ctx.dtype))
     if "bo" in p:
         out = out + p["bo"]
     return out, kv_cache
 
 
-def mlp_block(cfg: ModelConfig, p: Dict[str, Any], x: jnp.ndarray) -> jnp.ndarray:
+def mlp_block(cfg: ModelConfig, p: Dict[str, Any], x: jnp.ndarray,
+              tp_comm=None) -> jnp.ndarray:
     h = maybe_fp8_matmul(cfg, x, deq(p["w_in"], x.dtype))
     if "b_in" in p:
         h = h + p["b_in"]
     h = apply_activation(cfg.activation, h)
-    out = maybe_fp8_matmul(cfg, h, deq(p["w_out"], h.dtype))
+    if tp_comm is not None and "mlp_out" in tp_comm.sites:
+        from megatron_tpu.quant.collectives import row_parallel_matmul
+
+        out = row_parallel_matmul(h, deq(p["w_out"], h.dtype), tp_comm,
+                                  "mlp_out")
+    else:
+        out = maybe_fp8_matmul(cfg, h, deq(p["w_out"], h.dtype))
     if "b_out" in p:
         out = out + p["b_out"]
     return out
 
 
-def _ffn(cfg: ModelConfig, lp: Dict[str, Any], x: jnp.ndarray):
+def _ffn(cfg: ModelConfig, lp: Dict[str, Any], x: jnp.ndarray,
+         tp_comm=None):
     """Dense MLP or MoE, by config. Returns (out, aux_loss fp32 scalar)."""
     if cfg.num_experts is not None:
         return moe_block(cfg, lp["moe"], x)
-    return mlp_block(cfg, lp["mlp"], x), jnp.zeros((), jnp.float32)
+    return (mlp_block(cfg, lp["mlp"], x, tp_comm=tp_comm),
+            jnp.zeros((), jnp.float32))
 
 
 def block_forward(
@@ -320,6 +344,7 @@ def block_forward(
     page_table: Optional[jnp.ndarray] = None,  # [B, max_pages] int32
     page_write_start: Optional[jnp.ndarray] = None,
     page_write_end: Optional[jnp.ndarray] = None,
+    tp_comm=None,
 ) -> Tuple[jnp.ndarray, Optional[Tuple[jnp.ndarray, jnp.ndarray]], jnp.ndarray]:
     """One decoder layer -> (y, kv_cache, moe_aux_loss).
 
@@ -343,6 +368,7 @@ def block_forward(
         page_table=page_table,
         page_write_start=page_write_start,
         page_write_end=page_write_end,
+        tp_comm=tp_comm,
     )
     attn_out = _dropout(attn_out, rate, k_hidden1 if cfg.hidden_dropout > 0 else None)
 
@@ -350,7 +376,7 @@ def block_forward(
         # Falcon: mlp input is ln1(x) (7B) or a dedicated ln_mlp(x) (40B);
         # one residual add for both branches.
         mlp_in = _norm(cfg, lp["ln_mlp"], x) if cfg.parallel_layernorm else normed
-        mlp_out, moe_aux = _ffn(cfg, lp, mlp_in)
+        mlp_out, moe_aux = _ffn(cfg, lp, mlp_in, tp_comm=tp_comm)
         mlp_out = _dropout(mlp_out, rate, k_hidden2 if cfg.hidden_dropout > 0 else None)
         res = normed if cfg.apply_residual_post_ln else x
         y = res + attn_out + mlp_out
@@ -361,7 +387,7 @@ def block_forward(
         y = res1 + attn_out
         y = sharder(y, "residual")
         normed2 = _norm(cfg, lp["ln2"], y)
-        mlp_out, moe_aux = _ffn(cfg, lp, normed2)
+        mlp_out, moe_aux = _ffn(cfg, lp, normed2, tp_comm=tp_comm)
         mlp_out = _dropout(mlp_out, rate, k_hidden2 if cfg.hidden_dropout > 0 else None)
         res2 = normed2 if cfg.apply_residual_post_ln else y
         y = res2 + mlp_out

@@ -1,0 +1,27 @@
+"""Quantized-communication subsystem (Flash Communication, 2412.04964).
+
+Low-bit (int8 / fp8) tensor-parallel collectives for serving:
+quantize/dequantize primitives with unit-tested worst-case error bounds
+(primitives.py), compressed psum / all-gather wrappers + the model-facing
+shard_map seams (collectives.py), and the exposure-driven per-site
+enable policy (policy.py — stdlib-only, loadable without jax).
+
+Wired into serving via ``--serve_compress_collectives {none,int8,fp8}``
+and ``--serve_comm_policy`` (docs/serving.md); byte reduction is pinned
+by the decode_tp2_* golden comm manifests (docs/performance.md
+"Compressed collectives").
+"""
+
+from megatron_tpu.quant.collectives import (  # noqa: F401
+    MODES, TpComm, compressed_all_gather, compressed_psum,
+    forward_comm_bytes, make_tp_comm, row_parallel_matmul,
+    vocab_parallel_logits,
+)
+from megatron_tpu.quant.policy import (  # noqa: F401
+    CommPolicy, DEFAULT_SITES, SITE_COLLECTIVES, default_policy,
+    load_policy, policy_from_exposure, resolve_policy,
+)
+from megatron_tpu.quant.primitives import (  # noqa: F401
+    dequantize_chunked, effective_chunk, fp8_supported, quantize_chunked,
+    quantization_error_bound,
+)

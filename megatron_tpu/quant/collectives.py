@@ -1,0 +1,322 @@
+"""Compressed tensor-parallel collectives (Flash Communication, TPU form).
+
+Serving latency at tensor parallelism is dominated by the per-layer
+output reductions (the row-parallel all-reduce after attention-out and
+mlp-out) and the vocab-parallel logits gather — collectives whose
+EXPOSED time the trace pipeline measures (ROADMAP item 2). This module
+replaces them, inside the existing jitted decode/prefill bodies, with
+low-bit versions (arXiv 2412.04964):
+
+  * ``compressed_psum`` — the Flash-AllReduce shape: per-chunk quantize
+    the partial sums, all-to-all the low-bit payload (+ scales riding
+    alongside), dequantize + reduce the local shard at full precision,
+    re-quantize, all-gather, dequantize. BOTH wire phases move int8/fp8
+    bytes; the reduction itself stays exact fp32.
+  * ``compressed_all_gather`` — quantize locally, gather payload +
+    scales, dequantize.
+
+Each wrapper is usable inside any shard_map body and falls back to the
+dense op when the mesh axis is trivial (tp == 1). ``row_parallel_matmul``
+and ``vocab_parallel_logits`` are the model-facing seams
+(models/transformer.py / language_model.py): GSPMD-compatible shard_map
+islands over the "tensor" axis that pick dense psum / compressed
+transport per site according to a :class:`TpComm` (mode + policy).
+
+Numerics: two quantization stages per psum — each bounded by
+quant/primitives.quantization_error_bound — so per-site output error is
+<= sum of both stages' chunk bounds; the engine-level gates
+(tests/test_quant_comm.py) hold the resulting greedy decode to >= 99%
+token match and a bounded max logit error against the dense engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, FrozenSet, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from megatron_tpu.analysis.taxonomy import wire_bytes_per_call
+from megatron_tpu.parallel.mesh import AXIS_TENSOR
+from megatron_tpu.quant.policy import (
+    CommPolicy, SITE_COLLECTIVES, resolve_policy,
+)
+from megatron_tpu.quant.primitives import (
+    dequantize_chunked, effective_chunk, fp8_supported, quantize_chunked,
+)
+
+#: the modes --serve_compress_collectives exposes plus the explicit
+#: "dense" baseline (same shard_map decomposition, full-precision psum /
+#: all_gather — the contract manifest the compressed ones diff against)
+MODES = ("none", "dense", "int8", "fp8")
+
+
+@dataclasses.dataclass(frozen=True)
+class TpComm:
+    """One engine's tensor-parallel communication plan: which mesh axis,
+    what transport precision, which sites route through the explicit
+    collectives. Static at engine build => compiled into the decode
+    step, zero traced args, zero recompiles."""
+
+    mesh: object                 # jax.sharding.Mesh
+    tp: int
+    mode: str                    # "dense" | "int8" | "fp8"
+    chunk: int = 32
+    axis: str = AXIS_TENSOR
+    sites: FrozenSet[str] = frozenset(SITE_COLLECTIVES)
+
+    def compresses(self) -> bool:
+        return self.mode in ("int8", "fp8")
+
+
+def make_tp_comm(mesh, mode: str, cfg=None, policy=None,
+                 chunk: int = 32) -> Optional[TpComm]:
+    """Build the engine's TpComm, or None when the configuration is a
+    no-op (mode "none", no mesh, or a trivial tensor axis — the dense
+    GSPMD path then serves unchanged).
+
+    policy: None (compress every site), a CommPolicy / {site: bool}
+    dict / policy-JSON path (quant/policy.py). Under mode "dense" the
+    policy still selects which sites take the EXPLICIT path (the
+    contract baseline routes all of them).
+    """
+    if mode not in MODES:
+        raise ValueError(f"compress_collectives must be one of {MODES}, "
+                         f"got {mode!r}")
+    if mode == "none" or mesh is None:
+        return None
+    tp = dict(mesh.shape).get(AXIS_TENSOR, 1)
+    if tp <= 1:
+        import warnings
+
+        warnings.warn(
+            f"compress_collectives={mode!r} requested but the mesh has a "
+            "trivial tensor axis — serving the dense path unchanged",
+            stacklevel=2)
+        return None
+    if mode == "fp8" and not fp8_supported():
+        raise ValueError(
+            "compress_collectives='fp8': this toolchain has no fp8 "
+            "dtype; use 'int8'")
+    if chunk < 1:
+        raise ValueError(f"comm chunk must be >= 1, got {chunk}")
+    pol = resolve_policy(policy)
+    sites = frozenset(pol.enabled_sites())
+    if cfg is not None:
+        _validate_cfg(cfg, tp, sites)
+    return TpComm(mesh=mesh, tp=tp, mode=mode, chunk=int(chunk),
+                  sites=sites)
+
+
+def _validate_cfg(cfg, tp: int, sites) -> None:
+    """Fail at engine build, not mid-trace: every dimension an enabled
+    site splits over the tensor axis must divide by tp — BOTH the
+    contracting dim (the shard_map in_spec split) and, for the psum
+    sites, the output width hidden_size (the two-step reduce splits the
+    psum payload's last dim across peers)."""
+    dims = {
+        "attn_out": (("attention width (heads x head_dim)",
+                      cfg.num_attention_heads * cfg.head_dim),
+                     ("hidden size", cfg.hidden_size)),
+        "mlp_out": (("ffn width", cfg.ffn_size),
+                    ("hidden size", cfg.hidden_size)),
+        "logits": (("vocab size", cfg.vocab_size),),
+    }
+    for site in sorted(sites):
+        for label, dim in dims[site]:
+            if dim % tp:
+                raise ValueError(
+                    f"compressed collectives: {label} {dim} is not "
+                    f"divisible by tensor_parallel {tp} (site {site!r}; "
+                    "disable it in the comm policy or change the "
+                    "geometry)")
+    if cfg.num_experts is not None:
+        raise ValueError(
+            "compressed collectives do not cover MoE expert dispatch — "
+            "serve MoE models with --serve_compress_collectives none")
+    if cfg.fp8_format is not None:
+        raise ValueError(
+            "compressed collectives with fp8 training matmuls "
+            "(cfg.fp8_format) is untested — drop one of the two")
+
+
+# ---------------------------------------------------------------------------
+# the collective wrappers (inside shard_map bodies)
+# ---------------------------------------------------------------------------
+
+
+def compressed_psum(x: jnp.ndarray, axis_name: str, mode: str = "int8",
+                    chunk: int = 32) -> jnp.ndarray:
+    """Flash-AllReduce inside a shard_map body: quantize -> all-to-all ->
+    exact local reduce -> re-quantize -> all-gather, scales riding
+    alongside each phase. Falls back to ``jax.lax.psum`` on a trivial
+    axis (nothing to compress) or under mode "dense"."""
+    tp = jax.lax.axis_size(axis_name)
+    if tp == 1 or mode == "dense":
+        return jax.lax.psum(x, axis_name)
+    last = x.ndim - 1
+    w = x.shape[-1]
+    if w % tp:
+        raise ValueError(f"compressed_psum: last-dim width {w} not "
+                         f"divisible by axis size {tp}")
+    # chunk must tile the PER-DEVICE slice so the scale rows split
+    # evenly through the all-to-all
+    c = effective_chunk(w // tp, chunk)
+    q, s = quantize_chunked(x, c, mode)
+    q = jax.lax.all_to_all(q, axis_name, split_axis=last,
+                           concat_axis=last, tiled=True)
+    s = jax.lax.all_to_all(s, axis_name, split_axis=last,
+                           concat_axis=last, tiled=True)
+    # device i now holds every peer's slice i: dequantize exactly, reduce
+    # at fp32 (the reduction itself is never low-bit — only the wire is)
+    part = dequantize_chunked(q, s, jnp.float32)
+    red = part.reshape(*part.shape[:-1], tp, w // tp).sum(-2)
+    q2, s2 = quantize_chunked(red, c, mode)
+    q2 = jax.lax.all_gather(q2, axis_name, axis=last, tiled=True)
+    s2 = jax.lax.all_gather(s2, axis_name, axis=last, tiled=True)
+    return dequantize_chunked(q2, s2, x.dtype)
+
+
+def compressed_all_gather(x: jnp.ndarray, axis_name: str,
+                          mode: str = "int8", chunk: int = 32,
+                          gather_axis: Optional[int] = None) -> jnp.ndarray:
+    """Low-bit all-gather inside a shard_map body: quantize the local
+    shard, gather payload + scales, dequantize. Dense fallback on a
+    trivial axis / mode "dense". gather_axis defaults to the last (the
+    quantized) axis."""
+    tp = jax.lax.axis_size(axis_name)
+    last = x.ndim - 1
+    ax = last if gather_axis is None else gather_axis
+    if tp == 1 or mode == "dense":
+        return jax.lax.all_gather(x, axis_name, axis=ax, tiled=True)
+    if ax != last:
+        raise ValueError("compressed_all_gather quantizes along the last "
+                         f"axis; gather_axis {ax} != {last}")
+    c = effective_chunk(x.shape[-1], chunk)
+    q, s = quantize_chunked(x, c, mode)
+    q = jax.lax.all_gather(q, axis_name, axis=last, tiled=True)
+    s = jax.lax.all_gather(s, axis_name, axis=last, tiled=True)
+    return dequantize_chunked(q, s, x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# model-facing seams (GSPMD-compatible shard_map islands)
+# ---------------------------------------------------------------------------
+
+
+def row_parallel_matmul(x: jnp.ndarray, w: jnp.ndarray, tpc: TpComm,
+                        site: str) -> jnp.ndarray:
+    """x [..., K] @ w [K, N] with the contraction sharded over the
+    tensor axis and the partial-sum reduction running as an EXPLICIT
+    collective (dense psum or the compressed two-step), instead of
+    GSPMD's inserted all-reduce. Sites the policy disabled keep the
+    plain einsum (GSPMD stays free to place it)."""
+    if tpc is None or site not in tpc.sites:
+        return jnp.einsum("...k,kn->...n", x, w)
+    if w.shape[0] % tpc.tp:
+        raise ValueError(
+            f"row_parallel_matmul[{site}]: contracting dim {w.shape[0]} "
+            f"not divisible by tp {tpc.tp}")
+
+    def body(xl, wl):
+        part = jnp.einsum("...k,kn->...n", xl, wl)
+        return compressed_psum(part, tpc.axis, mode=tpc.mode,
+                               chunk=tpc.chunk)
+
+    x_spec = P(*([None] * (x.ndim - 1)), tpc.axis)
+    return jax.shard_map(
+        body, mesh=tpc.mesh, in_specs=(x_spec, P(tpc.axis, None)),
+        out_specs=P(), check_vma=False)(x, w)
+
+
+def vocab_parallel_logits(x: jnp.ndarray, w: jnp.ndarray, tpc: TpComm,
+                          tied: bool) -> jnp.ndarray:
+    """Vocab-parallel logits projection with an EXPLICIT (optionally
+    compressed) all-gather over the tensor axis: each shard computes its
+    vocab slice, the gather re-assembles [..., V] for the sampler.
+    tied: w is the [V, h] embedding table; untied: the [h, V] lm head."""
+    if tpc is None or "logits" not in tpc.sites:
+        if tied:
+            return jnp.einsum("bsh,vh->bsv", x, w)
+        return jnp.einsum("bsh,hv->bsv", x, w)
+    v_dim = w.shape[0] if tied else w.shape[1]
+    if v_dim % tpc.tp:
+        raise ValueError(f"vocab_parallel_logits: vocab {v_dim} not "
+                         f"divisible by tp {tpc.tp}")
+
+    def body(xl, wl):
+        if tied:
+            local = jnp.einsum("bsh,vh->bsv", xl, wl)
+        else:
+            local = jnp.einsum("bsh,hv->bsv", xl, wl)
+        return compressed_all_gather(local, tpc.axis, mode=tpc.mode,
+                                     chunk=tpc.chunk)
+
+    w_spec = P(tpc.axis, None) if tied else P(None, tpc.axis)
+    return jax.shard_map(
+        body, mesh=tpc.mesh, in_specs=(P(), w_spec),
+        out_specs=P(), check_vma=False)(x, w)
+
+
+# ---------------------------------------------------------------------------
+# static byte accounting (telemetry counters + the comm_policy journal)
+# ---------------------------------------------------------------------------
+
+
+def _site_bytes(width: int, rows: int, tpc: TpComm,
+                act_itemsize: int, kind: str) -> Dict[str, int]:
+    """Wire bytes one site moves for `rows` tokens of a `width`-wide
+    payload: {"dense": explicit-dense bytes, "compressed": this mode's
+    bytes}. Uses the same wire model as the jaxpr auditor
+    (analysis/taxonomy.wire_bytes_per_call), so the live counters and
+    the golden manifests tell one story."""
+    n = tpc.tp
+    payload = rows * width * act_itemsize
+    if kind == "all-reduce":
+        dense = wire_bytes_per_call("psum", payload, n)
+    else:
+        dense = wire_bytes_per_call("all_gather", payload, n)
+    if not tpc.compresses():
+        return {"dense": dense, "compressed": dense}
+    if kind == "all-reduce":
+        c = effective_chunk(width // n, tpc.chunk)
+        q = rows * width                      # int8/fp8: 1 byte/elt
+        s = rows * (width // c) * 4           # fp32 scales
+        comp = (wire_bytes_per_call("all_to_all", q + s, n)
+                + wire_bytes_per_call("all_gather", q + s, n))
+    else:
+        c = effective_chunk(width, tpc.chunk)
+        q = rows * width
+        s = rows * (width // c) * 4
+        comp = wire_bytes_per_call("all_gather", q + s, n)
+    return {"dense": dense, "compressed": comp}
+
+
+def forward_comm_bytes(cfg, tpc: Optional[TpComm], batch: int,
+                       seq: int) -> Dict[str, int]:
+    """Per-forward wire bytes of the explicit TP collectives for a
+    [batch, seq] token pass: {"dense", "compressed"}. Zero when tpc is
+    None (single-chip or mode none — GSPMD's collectives are not
+    routed through the explicit seam and are not counted here)."""
+    out = {"dense": 0, "compressed": 0}
+    if tpc is None:
+        return out
+    rows = batch * seq
+    act = jnp.dtype(cfg.dtype).itemsize
+    per_layer = []
+    if "attn_out" in tpc.sites:
+        per_layer.append(_site_bytes(cfg.hidden_size, rows, tpc, act,
+                                     "all-reduce"))
+    if "mlp_out" in tpc.sites:
+        per_layer.append(_site_bytes(cfg.hidden_size, rows, tpc, act,
+                                     "all-reduce"))
+    for b in per_layer:
+        out["dense"] += b["dense"] * cfg.num_layers
+        out["compressed"] += b["compressed"] * cfg.num_layers
+    if "logits" in tpc.sites:
+        b = _site_bytes(cfg.vocab_size, rows, tpc, act, "all-gather")
+        out["dense"] += b["dense"]
+        out["compressed"] += b["compressed"]
+    return out

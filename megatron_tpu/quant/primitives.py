@@ -1,0 +1,119 @@
+"""Quantize/dequantize primitives for compressed collectives.
+
+The stdlib-of-the-repo low-bit recipe the compressed TP collectives
+(quant/collectives.py) are built from: per-chunk symmetric scaling along
+the LAST axis, int8 (127-level clamp/round, the same recipe as
+ops/kv_quant.py but chunked instead of per-vector) or fp8 e4m3 where the
+toolchain carries the dtype. Chunked scales are what makes activation
+quantization safe for communication: one outlier poisons only its own
+`chunk` elements, not the whole tensor (Flash Communication 2412.04964's
+fine-grained-scale argument).
+
+Every recipe ships with a WORST-CASE round-trip error bound
+(``quantization_error_bound``) that is a unit-tested invariant
+(tests/test_quant_comm.py): for every element,
+
+    |x - deq(quant(x))| <= bound(x)
+
+  * int8: the symmetric scale is chunk_amax / 127, values land exactly in
+    [-127, 127], so the only error is round-to-nearest: bound = scale / 2.
+  * fp8 (e4m3fn, 3 mantissa bits): normals round within a relative
+    half-ulp of 2^-4; subnormals (|u| < 2^-6 after scaling) within an
+    absolute 2^-10 of the scaled value: bound = |x| * 2^-4 + scale * 2^-10.
+
+No engine/model imports — this module is leaf-level like ops/kv_quant.py.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+#: fp8 transport format: e4m3fn (the forward-activation format; e5m2's
+#: 2-bit mantissa would double the rounding error for no range benefit on
+#: amax-normalized chunks)
+FP8_DTYPE_NAME = "float8_e4m3fn"
+
+
+def fp8_supported() -> bool:
+    """Whether this jax/ml_dtypes build carries the fp8 transport dtype
+    (the --serve_compress_collectives fp8 gate)."""
+    return hasattr(jnp, FP8_DTYPE_NAME)
+
+
+def _fp8_dtype():
+    if not fp8_supported():
+        raise ValueError(
+            f"this toolchain has no jnp.{FP8_DTYPE_NAME}; use int8 "
+            "compressed collectives instead")
+    return getattr(jnp, FP8_DTYPE_NAME)
+
+
+def effective_chunk(width: int, chunk: int) -> int:
+    """The largest divisor of `width` that is <= `chunk` (>= 1): the
+    scale granularity actually used when the requested chunk does not
+    tile the quantized axis."""
+    if width < 1:
+        raise ValueError(f"width must be >= 1, got {width}")
+    c = max(1, min(int(chunk), width))
+    while width % c:
+        c -= 1
+    return c
+
+
+def _chunked(x: jnp.ndarray, chunk: int) -> jnp.ndarray:
+    """[..., W] -> [..., W/chunk, chunk] fp32 view."""
+    w = x.shape[-1]
+    if w % chunk:
+        raise ValueError(f"chunk {chunk} does not divide width {w} "
+                         "(use effective_chunk)")
+    return x.astype(jnp.float32).reshape(*x.shape[:-1], w // chunk, chunk)
+
+
+def quantize_chunked(x: jnp.ndarray, chunk: int, mode: str = "int8"
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """[..., W] float -> (q low-bit [..., W], scales fp32 [..., W/chunk])
+    with per-chunk symmetric max-abs scaling along the last axis."""
+    xc = _chunked(x, chunk)
+    amax = jnp.max(jnp.abs(xc), axis=-1, keepdims=True)
+    if mode == "int8":
+        scale = jnp.maximum(amax, 1e-8) / 127.0
+        q = jnp.clip(jnp.round(xc / scale), -127, 127).astype(jnp.int8)
+    elif mode == "fp8":
+        dt = _fp8_dtype()
+        scale = jnp.maximum(amax, 1e-8) / float(jnp.finfo(dt).max)
+        q = (xc / scale).astype(dt)
+    else:
+        raise ValueError(f"unknown quantization mode {mode!r} "
+                         "(expected 'int8' or 'fp8')")
+    return q.reshape(x.shape), scale[..., 0]
+
+
+def dequantize_chunked(q: jnp.ndarray, scales: jnp.ndarray,
+                       dtype=jnp.float32) -> jnp.ndarray:
+    """Inverse of quantize_chunked: scales broadcast back over their
+    chunk."""
+    w = q.shape[-1]
+    chunk = w // scales.shape[-1]
+    qc = q.astype(jnp.float32).reshape(*q.shape[:-1], w // chunk, chunk)
+    return (qc * scales[..., None]).reshape(q.shape).astype(dtype)
+
+
+def quantization_error_bound(x: jnp.ndarray, chunk: int,
+                             mode: str = "int8") -> jnp.ndarray:
+    """Per-element worst-case |x - deq(quant(x))| for the recipes above
+    (module docstring derivation). Unit-tested invariant, and the number
+    the parity gates' logit-error thresholds are derated from."""
+    xc = _chunked(x, chunk)
+    amax = jnp.max(jnp.abs(xc), axis=-1, keepdims=True)
+    if mode == "int8":
+        scale = jnp.maximum(amax, 1e-8) / 127.0
+        bound = jnp.broadcast_to(scale / 2.0, xc.shape)
+    elif mode == "fp8":
+        dt = _fp8_dtype()
+        scale = jnp.maximum(amax, 1e-8) / float(jnp.finfo(dt).max)
+        bound = jnp.abs(xc) * 2.0 ** -4 + scale * 2.0 ** -10
+    else:
+        raise ValueError(f"unknown quantization mode {mode!r}")
+    return bound.reshape(x.shape)

@@ -65,6 +65,10 @@ def test_bench_main_emits_one_json_line(monkeypatch):
         bench, "serve_slo_bench",
         functools.partial(bench.serve_slo_bench, num_requests=8,
                           new_tokens=4))
+    monkeypatch.setattr(
+        bench, "serve_compressed_comm_bench",
+        functools.partial(bench.serve_compressed_comm_bench,
+                          num_slots=2, new_tokens=8, reps=1))
     buf = io.StringIO()
     with redirect_stdout(buf):
         bench.main()
@@ -72,7 +76,7 @@ def test_bench_main_emits_one_json_line(monkeypatch):
     # full (non-quick) runs: the serving metric lines + the preemption
     # notice-budget line, then the headline LAST (the only positional
     # contract the driver relies on)
-    assert len(lines) == 6
+    assert len(lines) == 7
     serve = json.loads(lines[0])
     assert serve["metric"] == "serve_decode_throughput_toks_per_s"
     assert set(serve) >= {"metric", "value", "unit", "vs_baseline"}
@@ -95,14 +99,22 @@ def test_bench_main_emits_one_json_line(monkeypatch):
     assert spec["detail"]["accept_rate"] >= 0.9, spec
     assert spec["detail"]["decode_recompiles_after_warmup"] == 0
     assert spec["vs_baseline"] > 0, spec
-    slo = json.loads(lines[3])
+    comm = json.loads(lines[3])
+    assert comm["metric"] == "serve_compressed_comm"
+    assert "error" not in comm, comm
+    # the deterministic gate: the committed manifest pair must show the
+    # >= 3x wire-byte reduction (wall delta is informational on CPU)
+    assert comm["value"] >= 3.0, comm
+    assert comm["detail"]["decode_recompiles_after_warmup"] == 0
+    assert comm["detail"]["counter_compressed_bytes"] > 0
+    slo = json.loads(lines[4])
     assert slo["metric"] == "serve_slo_offered_load"
     assert "error" not in slo, slo
     # every request must complete (a lost request zeroes the line) and
     # the percentile block must be populated
     assert slo["value"] > 0 and slo["detail"]["failed"] == 0, slo
     assert set(slo["detail"]["ttft_s"]) == {"p50", "p95", "p99"}
-    pre = json.loads(lines[4])
+    pre = json.loads(lines[5])
     assert pre["metric"] == "preempt_save_latency_ms"
     assert "error" not in pre, pre
     assert pre["value"] > 0
@@ -189,7 +201,8 @@ def test_bench_probe_retries_until_backend_up(monkeypatch):
     # that ride along in a full main() entirely (their real coverage is
     # test_bench_main_emits_one_json_line + the slow speedup gate)
     for leg in ("serving_engine_bench", "serve_prefix_cache_bench",
-                "serve_speculative_bench", "serve_slo_bench"):
+                "serve_speculative_bench", "serve_compressed_comm_bench",
+                "serve_slo_bench"):
         monkeypatch.setattr(
             bench, leg,
             lambda deadline, _leg=leg, **kw: {"metric": _leg, "value": 0.0})

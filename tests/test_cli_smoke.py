@@ -43,6 +43,31 @@ def test_comm_report_cli_check():
     assert "comm contracts: OK" in out.stdout
 
 
+def test_comm_report_cli_diff():
+    # the dense-vs-compressed reduction as one command (ISSUE 15
+    # satellite) — reads golden JSON only, no jax import
+    out = _run([os.path.join("tools", "comm_report.py"), "--diff",
+                "decode_tp2_dense", "decode_tp2_int8"])
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "wire-byte ratio decode_tp2_dense / decode_tp2_int8" in out.stdout
+    assert "[q]" in out.stdout  # compressed entries are marked
+
+
+def test_trace_report_cli_emit_comm_policy(tmp_path):
+    # exposure-driven policy derivation straight off the checked-in
+    # fixture trace, through the by-path loader (still no jax import)
+    pol = tmp_path / "policy.json"
+    out = _run([os.path.join("tools", "trace_report.py"), FIXTURE,
+                "--emit-comm-policy", str(pol)])
+    assert out.returncode == 0, out.stdout + out.stderr
+    doc = json.loads(pol.read_text())
+    # the fixture's all-reduce is 87% exposed => psum sites compress;
+    # no all-gather was measured => the logits site stays dense
+    assert doc["sites"] == {"attn_out": True, "mlp_out": True,
+                            "logits": False}
+    assert doc["exposure"]["all-reduce"] > 0.8
+
+
 def test_trace_report_cli_help_and_fixture():
     out = _run([os.path.join("tools", "trace_report.py"), "--help"])
     assert out.returncode == 0, out.stderr

@@ -106,6 +106,25 @@ def extra_args(parser):
                    help="compile the decode step before /readyz goes "
                         "green, so a fleet router or k8s-style prober "
                         "never routes a request into the warmup compile")
+    g.add_argument("--serve_compress_collectives",
+                   choices=("none", "int8", "fp8"), default="none",
+                   help="low-bit tensor-parallel collectives in the "
+                        "serving engine (quant/, docs/serving.md): the "
+                        "per-layer TP output reductions and the vocab-"
+                        "parallel logits gather move int8/fp8 payloads "
+                        "with per-chunk scales riding alongside (Flash "
+                        "Communication) — >= 3x fewer collective wire "
+                        "bytes than dense (the decode_tp2_* golden comm "
+                        "manifests). No-op unless --tensor_parallel > 1; "
+                        "greedy output is gated at >= 99%% token match "
+                        "vs the dense engine (int8)")
+    g.add_argument("--serve_comm_policy", default=None,
+                   help="path to a per-collective compression policy "
+                        "JSON (tools/trace_report.py --emit-comm-policy "
+                        "derives one from a runtime trace's measured "
+                        "exposed fractions): sites whose collective time "
+                        "hides under compute stay dense. Default: "
+                        "compress every site")
     g.add_argument("--serve_profile_dir", default=None,
                    help="output dir for POST /admin/profile on-demand "
                         "captures (default runs/serve_profile); read the "
@@ -270,7 +289,9 @@ def main(argv=None):
                speculative=args.serve_speculative,
                spec_k=args.serve_spec_k,
                draft_cfg=draft_cfg, draft_params=draft_params,
-               profile_dir=args.serve_profile_dir)
+               profile_dir=args.serve_profile_dir,
+               compress_collectives=args.serve_compress_collectives,
+               comm_policy=args.serve_comm_policy)
 
 
 if __name__ == "__main__":

@@ -245,7 +245,24 @@ def _summarize_serving(events: List[Dict[str, Any]]
     reqs = [e for e in events if e.get("kind") == "serve_request"]
     routes = [e for e in events if e.get("kind") == "serve_route"]
     specs = [e for e in events if e.get("kind") == "serve_spec"]
+    comms = [e for e in events if e.get("kind") == "comm_policy"]
     out: Dict[str, Any] = {}
+    if comms:
+        # one comm_policy record per engine build (docs/serving.md
+        # "Compressed collectives"): which TP collectives run
+        # compressed and the static per-tick wire prices — their ratio
+        # IS the compression ratio the engine_comm_*_bytes_total
+        # counters realize live
+        c = comms[-1]
+        dense = int(c.get("dense_bytes_per_tick", 0))
+        comp = int(c.get("compressed_bytes_per_tick", 0))
+        out["comm"] = {
+            "mode": c.get("mode"), "sites": c.get("sites"),
+            "tp": c.get("tp"), "chunk": c.get("chunk"),
+            "dense_bytes_per_tick": dense,
+            "compressed_bytes_per_tick": comp,
+            "compression_ratio": round(dense / max(comp, 1), 3),
+        }
     if specs:
         # serve_spec records are cumulative per engine process (emitted
         # on each retire); the LAST one is the totals. accept_rate is
@@ -402,6 +419,13 @@ def render(summary: Dict[str, Any]) -> str:
                 f"  speculative ({s['drafter']}, k={s['k']}): "
                 f"accept rate {s['accept_rate']} | "
                 f"{s['tokens_per_forward']} tokens/forward")
+        if "comm" in sv:
+            c = sv["comm"]
+            lines.append(
+                f"  compressed collectives ({c['mode']}, tp={c['tp']}, "
+                f"sites {c['sites']}): {c['compression_ratio']}x fewer "
+                f"wire bytes ({c['dense_bytes_per_tick']} -> "
+                f"{c['compressed_bytes_per_tick']} B/tick)")
         if "router" in sv:
             r = sv["router"]
             lines.append(f"  router: {r['routed']} routed | "

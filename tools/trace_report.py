@@ -38,9 +38,12 @@ from pathlib import Path
 _REPO = Path(__file__).resolve().parent.parent
 _PKG = _REPO / "megatron_tpu"
 
-#: load order respects intra-package imports (taxonomy first)
+#: load order respects intra-package imports (taxonomy first).
+#: quant.policy is stdlib-only like taxonomy: deriving a comm policy
+#: from a trace must not need jax either.
 _MODULES = (
     ("megatron_tpu.analysis.taxonomy", _PKG / "analysis" / "taxonomy.py"),
+    ("megatron_tpu.quant.policy", _PKG / "quant" / "policy.py"),
     ("megatron_tpu.telemetry.tracing.proto",
      _PKG / "telemetry" / "tracing" / "proto.py"),
     ("megatron_tpu.telemetry.tracing.xplane",
@@ -68,6 +71,7 @@ def _load_tracing():
     else:
         if "megatron_tpu" not in sys.modules:
             for pkg in ("megatron_tpu", "megatron_tpu.analysis",
+                        "megatron_tpu.quant",
                         "megatron_tpu.telemetry",
                         "megatron_tpu.telemetry.tracing"):
                 mod = types.ModuleType(pkg)
@@ -88,7 +92,8 @@ def _load_tracing():
             loaded[name] = mod
     return (loaded["megatron_tpu.telemetry.tracing.xplane"],
             loaded["megatron_tpu.telemetry.tracing.events"],
-            loaded["megatron_tpu.telemetry.tracing.analyze"])
+            loaded["megatron_tpu.telemetry.tracing.analyze"],
+            loaded["megatron_tpu.quant.policy"])
 
 
 def _fmt_s(s: float) -> str:
@@ -172,9 +177,20 @@ def main(argv=None) -> int:
     ap.add_argument("--format", choices=("text", "json"), default="text")
     ap.add_argument("--check", action="store_true",
                     help="with --contract: exit 1 on measured!=expected")
+    ap.add_argument("--emit-comm-policy", metavar="OUT.json", default=None,
+                    help="derive the compressed-collective site policy "
+                         "from this trace's measured per-collective "
+                         "EXPOSED fractions (quant/policy.py) and write "
+                         "it as JSON — serve it back with "
+                         "--serve_comm_policy OUT.json")
+    ap.add_argument("--exposed-threshold", type=float, default=0.25,
+                    help="exposed fraction at/above which a collective "
+                         "kind's sites compress (default 0.25: a "
+                         "collective 75%%-hidden under compute is not "
+                         "worth the quantization error)")
     args = ap.parse_args(argv)
 
-    xplane, events_mod, analyze = _load_tracing()
+    xplane, events_mod, analyze, policy_mod = _load_tracing()
     files = xplane.find_xplane_files(
         args.trace, latest_session_only=not args.all_sessions)
     if not files:
@@ -194,6 +210,21 @@ def main(argv=None) -> int:
         comparison = analyze.compare_contract(
             report, json.loads(path.read_text()), args.contract,
             executions=args.executions)
+
+    if args.emit_comm_policy:
+        exposure = {c.op: round(c.exposed_frac, 4)
+                    for c in report.collectives}
+        policy = policy_mod.policy_from_exposure(
+            exposure, threshold=args.exposed_threshold,
+            source=f"trace:{args.trace}")
+        doc = dict(policy.to_dict(), exposure=exposure)
+        with open(args.emit_comm_policy, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+        print(f"# comm policy -> {args.emit_comm_policy}: "
+              + ", ".join(f"{s}={'on' if v else 'off'}"
+                          for s, v in sorted(doc["sites"].items())),
+              file=sys.stderr)
 
     if args.format == "json":
         out = {"files": files, "report": report.to_dict(top=args.top)}
