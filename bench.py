@@ -1066,6 +1066,87 @@ def preempt_save_bench(deadline, preempt_iter=4, train_iters=64):
     return line
 
 
+def train_attention_bwd_bench(deadline, b=2, s=512, hq=4, hkv=2, d=64,
+                              iters=3):
+    """Custom-vjp flash gradient step vs the XLA-grad step (pre-headline,
+    ISSUE 16). The deterministic gate — and the thing tracked across
+    PRs — is that the GRADIENT jaxpr of attention(impl='pallas')
+    contains the template's pallas kernels (the fused recompute
+    backward) and that --no_flash_bwd's doesn't: `value` is the wall
+    speedup of the flash grad step over the dense one and is
+    informational only (on a CPU host the kernels run under the pallas
+    interpreter, so wall there measures the interpreter, not the
+    kernels — the gate is what must hold)."""
+    import warnings
+
+    line = {"metric": "train_attention_bwd_speedup", "value": 0.0,
+            "unit": "x_wall_vs_xla_grad", "vs_baseline": 0.0, "detail": {}}
+    if deadline - time.perf_counter() < 30:
+        line["error"] = "budget_exhausted"
+        return line
+    import unittest.mock
+
+    import jax
+    import jax.numpy as jnp
+
+    from megatron_tpu.ops.attention import attention
+
+    try:
+        on_cpu = jax.default_backend() == "cpu"
+        env = {"MEGATRON_TPU_FLASH_INTERPRET": "1"} if on_cpu else {}
+        rng = np.random.default_rng(3)
+        q, k, v = (jnp.asarray(rng.standard_normal((b, s, h, d)),
+                               jnp.float32)
+                   for h in (hq, hkv, hkv))
+
+        def loss_flash(q, k, v):
+            return jnp.sum(jnp.square(attention(q, k, v, impl="pallas")))
+
+        def loss_dense(q, k, v):
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")  # the deliberate loud path
+                return jnp.sum(jnp.square(
+                    attention(q, k, v, impl="pallas", flash_bwd=False)))
+
+        def wall(f):
+            g = jax.jit(jax.grad(f, argnums=(0, 1, 2)))
+            jax.block_until_ready(g(q, k, v))  # compile
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = g(q, k, v)
+            jax.block_until_ready(out)
+            return (time.perf_counter() - t0) / iters
+
+        with unittest.mock.patch.dict(os.environ, env):
+            jx_flash = str(jax.make_jaxpr(
+                jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v))
+            jx_dense = str(jax.make_jaxpr(
+                jax.grad(loss_dense, argnums=(0, 1, 2)))(q, k, v))
+            gate = ("pallas_call" in jx_flash
+                    and "pallas_call" not in jx_dense)
+            t_flash = wall(loss_flash)
+            t_dense = wall(loss_dense)
+
+        line["value"] = round(t_dense / max(t_flash, 1e-9), 3)
+        line["detail"] = {
+            "bwd_jaxpr_has_kernel": "pallas_call" in jx_flash,
+            "dense_jaxpr_kernel_free": "pallas_call" not in jx_dense,
+            "kernel_calls_in_grad": jx_flash.count("pallas_call"),
+            "flash_grad_ms": round(t_flash * 1e3, 2),
+            "xla_grad_ms": round(t_dense * 1e3, 2),
+            "interpret_mode": on_cpu,
+            "geometry": {"b": b, "s": s, "hq": hq, "hkv": hkv, "d": d},
+        }
+        if not gate:
+            line["error"] = ("flash bwd gate failed: gradient jaxpr "
+                             "missing the pallas kernels (or the dense "
+                             "escape hatch still contains them)")
+    except Exception as e:  # noqa: BLE001 - pre-headline lines must never
+        # kill the run (the headline MFU contract)
+        line["error"] = str(e)[:300]
+    return line
+
+
 def moe_dispatch_bench(deadline, peak):
     """Iso-parameter 4-expert/top-2 MoE at the headline geometry, capacity
     vs dropless dispatch MFU (useful-FLOP accounting like
@@ -1234,7 +1315,7 @@ def main():
             "micro_bs": cand["micro_bs"],
             "recompute": cand["granularity"],
             "ce_chunk": cand["ce_chunk"],
-            "attention": "pallas(splash)",
+            "attention": "pallas(flash_template)",
             "sweep": sweep,
         }
         detail.update(extras)
@@ -1327,6 +1408,10 @@ def main():
             print(json.dumps(serve_slo_bench(deadline)), flush=True)
             # preemption notice budget: SIGTERM -> committed checkpoint
             print(json.dumps(preempt_save_bench(deadline)), flush=True)
+            # flash bwd gate: the gradient jaxpr must contain the
+            # template's kernels (wall speedup informational)
+            print(json.dumps(train_attention_bwd_bench(deadline)),
+                  flush=True)
         if want_extras:
             run_extras(deadline, peak, extras)
 

@@ -35,12 +35,22 @@ class AuditTarget:
     args: tuple                  # ShapeDtypeStructs (sharded where needed)
     mesh: Optional[Any] = None   # entered (set_mesh) around trace/lower
     can_compile: bool = True     # False: old-XLA paths that CHECK-crash
+    env: Optional[Dict[str, str]] = None  # env vars set around trace/lower
 
     def _scope(self):
         import contextlib
+        import os
+        import unittest.mock
 
-        return (jax.sharding.set_mesh(self.mesh) if self.mesh is not None
-                else contextlib.nullcontext())
+        stack = contextlib.ExitStack()
+        if self.mesh is not None:
+            stack.enter_context(jax.sharding.set_mesh(self.mesh))
+        if self.env:
+            # trace-time dispatch switches (e.g. MEGATRON_TPU_FLASH_INTERPRET
+            # routes attention through the pallas template on a CPU host)
+            stack.enter_context(
+                unittest.mock.patch.dict(os.environ, self.env))
+        return stack
 
     def jaxpr(self):
         with self._scope():
@@ -116,6 +126,25 @@ def train_step_target(name: str, parallel_kwargs: Dict[str, Any],
         loop.state, loop.state_shardings)
     return AuditTarget(name=name, fn=step, args=(state, batch),
                        mesh=loop.rt.mesh)
+
+
+def flash_bwd_train_step_target(
+        name: str = "train_flash_bwd") -> AuditTarget:
+    """The production train step with attention routed through the flash
+    template (ops/pallas/flash_template.py): interpret mode is forced via
+    the env knob so the CPU host traces the REAL kernel dispatch, and the
+    audited gradient path is the custom-vjp recompute backward — the
+    pallas calls sit visibly in the jaxpr (asserted in
+    tests/test_analysis.py; bench.py gates on the same fact) instead of
+    an XLA-generated O(S^2) attention gradient. Not part of
+    contracts.CONFIGS: pallas_call bodies hide their innards from the
+    jaxpr collective walk, so the golden-manifest ledger keeps auditing
+    the einsum form (identical collective structure — attention is
+    collective-free at dp=1)."""
+    t = train_step_target(
+        name, {}, model_overrides={"attention_impl": "pallas"})
+    return dataclasses.replace(
+        t, env={"MEGATRON_TPU_FLASH_INTERPRET": "1"})
 
 
 # ---------------------------------------------------------------------------

@@ -176,6 +176,12 @@ class ModelConfig:
     # (falls back to xla for unsupported shapes), or "ring" context-parallel
     # ring attention (requires an ambient mesh with a "context" axis).
     attention_impl: str = "xla"
+    # route full-sequence attention through the flash template's
+    # custom-vjp kernel (ops/pallas/flash_template.py) so training never
+    # pays the XLA-generated O(S^2) attention gradient; --no_flash_bwd
+    # is the escape hatch (dense gradient, loudly logged). Only
+    # meaningful under attention_impl="pallas".
+    flash_bwd: bool = True
 
     # BERT-style extras (ref: megatron/model/bert_model.py,
     # language_model.py Embedding tokentype path)

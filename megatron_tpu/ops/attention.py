@@ -12,8 +12,15 @@ Two implementations behind one dispatch:
   * "xla": einsum attention with fp32 softmax. XLA fuses
     scale+mask+softmax into the matmuls, which is what the reference's
     three fused CUDA softmax kernels exist to do by hand.
-  * "pallas": blockwise flash-attention kernel (megatron_tpu/ops/pallas/
-    flash_attention.py) — O(seq) memory, causal + sliding window + GQA.
+  * "pallas": the one FlashAttention-2 kernel family
+    (megatron_tpu/ops/pallas/flash_template.py) — O(seq) memory, causal
+    + sliding window + GQA, fused forward AND custom-vjp backward for
+    training/prefill, with decode / paged decode / multi-query decode as
+    the Sq-small specializations of the same template.
+
+Every pallas path here is an instantiation of that one template; this
+module only picks the instantiation (and the exact dense fallback for
+shapes/features the template doesn't cover).
 
 Layout is [batch, seq, heads, head_dim] throughout (no [s, b, h] flips —
 the reference's seq-first layout is a CUDA-kernel legacy).
@@ -26,6 +33,19 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+
+def _kernels_dispatchable() -> bool:
+    """True when attention() should route through the pallas template:
+    real hardware always; CPU hosts only when interpret mode is forced
+    (MEGATRON_TPU_FLASH_INTERPRET=1 — the interpreter is orders of
+    magnitude slower than fused XLA, so CPU sanity runs must not pay it;
+    tests/bench set the env var to trace/verify the kernel path)."""
+    if jax.default_backend() != "cpu":
+        return True
+    from megatron_tpu.ops.pallas.flash_template import interpret_forced
+
+    return interpret_forced()
 
 
 def _mask_bias(
@@ -65,6 +85,7 @@ def attention(
     softmax_fp32: bool = True,
     kv_lengths: Optional[jnp.ndarray] = None,  # [B] valid-prefix lengths
     page_table: Optional[jnp.ndarray] = None,  # [B, max_pages] int32
+    flash_bwd: bool = True,
 ) -> jnp.ndarray:
     """Scaled dot-product attention with GQA. Returns [B, Sq, Hq, D].
 
@@ -89,10 +110,16 @@ def attention(
     grid; everywhere else the pages are gathered into a dense [B, S, ...]
     view and the existing masked paths compute identical values (the
     gather is exact — pages hold the same bits a dense cache would).
+
+    flash_bwd: route full-sequence causal attention through the
+    template's custom-vjp kernel so jax.grad never builds the XLA
+    O(S^2) gradient (config.flash_bwd / --no_flash_bwd). False skips
+    the kernel for differentiable full-sequence passes — decode paths
+    (no gradient) still use the fused kernels.
     """
     if page_table is not None:
         if (kv_lengths is not None
-                and impl == "pallas" and jax.default_backend() != "cpu"):
+                and impl == "pallas" and _kernels_dispatchable()):
             try:
                 if q.shape[1] == 1:
                     from megatron_tpu.ops.pallas.paged_flash_decode import (
@@ -130,7 +157,7 @@ def attention(
         if dropout > 0.0 or padding_mask is not None:
             raise ValueError("kv_lengths is a serving-decode path: no "
                              "dropout / padding masks")
-        if impl == "pallas" and jax.default_backend() != "cpu":
+        if impl == "pallas" and _kernels_dispatchable():
             try:
                 if q.shape[1] == 1:
                     from megatron_tpu.ops.pallas.flash_decode import (
@@ -210,12 +237,16 @@ def attention(
             and padding_mask is None
             and q.shape[1] == k.shape[1]
             and mask_type == "causal"
-            # on CPU hosts the kernel would run under the pallas
-            # interpreter — orders of magnitude slower than the fused XLA
-            # path; presets default to impl='pallas', so CPU sanity runs
-            # must not pay that (tests exercise the kernels directly)
-            and jax.default_backend() != "cpu"
+            and _kernels_dispatchable()
         )
+        if can_use and not flash_bwd:
+            # escape hatch (--no_flash_bwd): deliberate, but still loud —
+            # the step now pays the XLA-generated O(S^2) attention
+            # gradient, which is the regression flash_bwd exists to stop
+            warnings.warn(
+                "flash_bwd disabled: full-sequence attention (and its "
+                "gradient) runs on the O(S^2) XLA path", stacklevel=2)
+            can_use = False
         if can_use:
             try:
                 from megatron_tpu.ops.pallas.flash_attention import flash_attention
@@ -229,9 +260,13 @@ def attention(
                 try:
                     return flash_attention(q, k, v, sliding_window=sliding_window)
                 except ValueError as e:
+                    # geometry the template can't instantiate — loud, so a
+                    # silent revert to the XLA-generated attention
+                    # gradient is impossible (tested: test_pallas_attention)
                     warnings.warn(
-                        f"flash kernel unavailable for this config ({e}); "
-                        "falling back to the O(S^2) XLA path", stacklevel=2)
+                        f"flash fwd+bwd template unavailable for this "
+                        f"config ({e}); attention AND its gradient fall "
+                        "back to the O(S^2) XLA path", stacklevel=2)
         # fall through to the XLA path for shapes/features the kernel
         # doesn't cover (decode steps, padding masks, dropout)
 

@@ -5,4 +5,11 @@ These are the TPU-native equivalents of the reference's CUDA kernel zoo
 layernorm) and its FlashAttention-2 dependency (transformer.py:9,524-553).
 Everything else the CUDA kernels fuse by hand, XLA fuses on TPU; attention
 is the one op where a hand-written blockwise kernel beats the compiler.
+
+Attention is ONE kernel family (flash_template.py, mask/block-skip
+predicates in masks.py): training/prefill fwd + custom-vjp recompute bwd,
+decode as the Sq-small specialization, page-table indirection / sliding
+window / kv_lengths masking / multi-query tiling as template knobs.
+flash_attention.py, flash_decode.py and paged_flash_decode.py are the
+stable import points for the instantiations.
 """

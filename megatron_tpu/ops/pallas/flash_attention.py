@@ -1,346 +1,55 @@
-"""Blockwise flash attention (forward + backward) in Pallas.
+"""Blockwise flash attention (forward + backward) — the prefill/training
+instantiation of the one kernel family in flash_template.py.
 
 TPU-native replacement for the reference's FlashAttention-2 dependency
 (megatron/model/transformer.py:524-553, incl. Mistral's sliding window
 :528-536) and, transitively, its fused scaled-masked-softmax CUDA kernels
 (megatron/fused_kernels/scaled_*_softmax*): O(S) memory exact attention
-with causal + sliding-window masking and GQA.
+with causal + sliding-window masking and GQA, and an FA-2 recompute
+backward via jax.custom_vjp so jax.grad through it never builds the XLA
+O(S^2) gradient.
 
-Layout: q [B, Sq, Hq, D], k/v [B, Skv, Hkv, D] (the framework's native
-layout); internally transposed to [B, H, S, D] so the (S, D) block is the
-MXU-facing tile. Grid (B, Hq, Sq/BQ, Skv/BK) with the kv axis innermost and
-sequential; online-softmax accumulators (m, l, acc) live in VMEM scratch
-that persists across the kv steps of one q block.
-
-Backward follows the FlashAttention-2 recompute scheme: residuals are
-(q, k, v, o, lse); delta = rowsum(do * o) is computed by XLA; one kernel
-accumulates dq over kv blocks, a second accumulates dk/dv over q blocks
-(per query head, group-summed outside for GQA).
-
-The public entry falls back to the XLA einsum path for shapes the kernel
-does not cover (sequence not divisible by the block size, decode steps).
+The kernels (fwd, dq, dk/dv), the custom_vjp wiring, the block-skip and
+the mask arithmetic all live in flash_template.py / masks.py; this module
+is the stable import point plus the splash-attention comparison baseline
+(jax's bundled block-sparse kernel, used as an A/B reference on real
+hardware via MEGATRON_TPU_SPLASH_ATTENTION=1 — the template is primary so
+training and prefill share one custom gradient path).
 """
 
 from __future__ import annotations
 
-import functools
-from typing import Optional, Tuple
+import os
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
-from megatron_tpu.ops.pallas.compat import CompilerParams as _CompilerParams
-
-DEFAULT_BLOCK = 256
-_NEG_INF = float(-1e30)
-
-
-def _interpret() -> bool:
-    # Pallas TPU kernels run in interpreter mode on CPU hosts (tests/CI)
-    import jax
-
-    return jax.default_backend() == "cpu"
-
-
-
-def _block_mask(qi, ki, causal: bool, window: Optional[int],
-                block_q: int, block_k: int, delta=0):
-    """[BQ, BK] bool mask from 2-D iotas (1-D iota lowers to scalar code on
-    TPU — keep everything 2-D).
-
-    delta (may be a traced scalar, e.g. an SMEM value): global-position
-    offset q_global - k_global of the two tiles' origins. The ring
-    attention path uses it so ONE kernel covers every stripe pair —
-    aligned-diagonal (delta 0), fully-past (delta >= kv length) and
-    shifted sliding-window bands — without per-case kernel variants."""
-    qq = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-    kk = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-    qq = qq + delta
-    m = jnp.ones((block_q, block_k), dtype=jnp.bool_)
-    if causal:
-        m &= kk <= qq
-    if window is not None:
-        m &= kk > qq - window
-    return m
+from megatron_tpu.ops.pallas.flash_template import (  # noqa: F401
+    DEFAULT_BLOCK,
+    _NEG_INF,
+    _bwd,
+    _delta_arr,
+    _dkv_kernel,
+    _dq_kernel,
+    _flash_bhsd,
+    _fwd,
+    _fwd_kernel,
+    _interpret,
+    _pick_block,
+    flash_mha,
+    supported,
+)
 
 
-# ---------------------------------------------------------------------------
-# forward
-# ---------------------------------------------------------------------------
-
-
-def _fwd_kernel(delta_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr,
-                *, scale: float, causal: bool, window: Optional[int],
-                block_q: int, block_k: int):
-    qi = pl.program_id(2)
-    ki = pl.program_id(3)
-    nk = pl.num_programs(3)
-
-    @pl.when(ki == 0)
-    def _init():
-        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
-        l_scr[:] = jnp.zeros_like(l_scr)
-        acc_scr[:] = jnp.zeros_like(acc_scr)
-
-    q = q_ref[0, 0].astype(jnp.float32) * scale     # [BQ, D]
-    k = k_ref[0, 0].astype(jnp.float32)             # [BK, D]
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [BQ, BK]
-
-    mask = _block_mask(qi, ki, causal, window, block_q, block_k,
-                       delta_ref[0])
-    s = jnp.where(mask, s, _NEG_INF)
-
-    m_prev = m_scr[:]                                # [BQ, 1]
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-    p = jnp.exp(s - m_new)
-    p = jnp.where(mask, p, 0.0)
-    alpha = jnp.exp(m_prev - m_new)
-    l_new = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
-    v = v_ref[0, 0].astype(jnp.float32)              # [BK, D]
-    pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())))  # [BQ, D]
-    acc_scr[:] = acc_scr[:] * alpha + pv
-    m_scr[:] = m_new
-    l_scr[:] = l_new
-
-    @pl.when(ki == nk - 1)
-    def _emit():
-        l = jnp.maximum(l_scr[:], 1e-30)
-        o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
-        # lane-padded to 128: [..., 1]-shaped outputs get tiled to 128 lanes
-        # anyway, and the narrow layout trips XLA's scoped-vmem stack
-        # allocation for custom-call outputs (observed on v5e)
-        lse_ref[0, 0] = jnp.broadcast_to(m_scr[:] + jnp.log(l),
-                                         lse_ref.shape[2:])
-
-
-def _delta_arr(delta):
-    """Scalar global-position offset -> [1] int32 SMEM operand."""
-    if delta is None:
-        return jnp.zeros((1,), jnp.int32)
-    return jnp.asarray(delta, jnp.int32).reshape(1)
-
-
-def _fwd(q, k, v, scale, causal, window, block_q, block_k, delta=None):
-    """q [B,Hq,Sq,D], k/v [B,Hq,Skv,D] (kv already group-broadcast).
-    Returns (o [B,Hq,Sq,D], lse [B,Hq,Sq]). delta: traced q-vs-k global
-    position offset (ring stripes); None = aligned."""
-    B, H, Sq, D = q.shape
-    Skv = k.shape[2]
-    grid = (B, H, Sq // block_q, Skv // block_k)
-
-    kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, window=window,
-        block_q=block_q, block_k=block_k)
-    o, lse = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_q, 128), lambda b, h, qi, ki: (b, h, qi, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
-            jax.ShapeDtypeStruct((B, H, Sq, 128), jnp.float32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, D), jnp.float32),
-        ],
-        compiler_params=_CompilerParams(
-            dimension_semantics=("parallel", "parallel", "parallel",
-                                 "arbitrary")),
-        interpret=_interpret(),
-    )(_delta_arr(delta), q, k, v)
-    return o, lse
-
-
-# ---------------------------------------------------------------------------
-# backward
-# ---------------------------------------------------------------------------
-
-
-def _dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-               dq_ref, dq_scr,
-               *, scale: float, causal: bool, window: Optional[int],
-               block_q: int, block_k: int):
-    qi = pl.program_id(2)
-    ki = pl.program_id(3)
-    nk = pl.num_programs(3)
-
-    @pl.when(ki == 0)
-    def _init():
-        dq_scr[:] = jnp.zeros_like(dq_scr)
-
-    q = q_ref[0, 0].astype(jnp.float32) * scale
-    k = k_ref[0, 0].astype(jnp.float32)
-    v = v_ref[0, 0].astype(jnp.float32)
-    do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0][:, 0:1]                      # [BQ, 1]
-    delta = delta_ref[0, 0][:, 0:1]                  # [BQ, 1]
-
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))
-    mask = _block_mask(qi, ki, causal, window, block_q, block_k, off_ref[0])
-    p = jnp.where(mask, jnp.exp(s - lse), 0.0)       # softmax probs
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))  # [BQ, BK]
-    ds = p * (dp - delta)
-    dq_scr[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ()))) * scale
-
-    @pl.when(ki == nk - 1)
-    def _emit():
-        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
-
-
-def _dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_scr, dv_scr,
-                *, scale: float, causal: bool, window: Optional[int],
-                block_q: int, block_k: int):
-    ki = pl.program_id(2)
-    qi = pl.program_id(3)
-    nq = pl.num_programs(3)
-
-    @pl.when(qi == 0)
-    def _init():
-        dk_scr[:] = jnp.zeros_like(dk_scr)
-        dv_scr[:] = jnp.zeros_like(dv_scr)
-
-    q = q_ref[0, 0].astype(jnp.float32) * scale
-    k = k_ref[0, 0].astype(jnp.float32)
-    v = v_ref[0, 0].astype(jnp.float32)
-    do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0][:, 0:1]
-    delta = delta_ref[0, 0][:, 0:1]
-
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))
-    mask = _block_mask(qi, ki, causal, window, block_q, block_k, off_ref[0])
-    p = jnp.where(mask, jnp.exp(s - lse), 0.0)       # [BQ, BK]
-    dv_scr[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())))
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))
-    ds = p * (dp - delta)
-    # q was pre-scaled on load, so this dot already carries the 1/sqrt(d)
-    dk_scr[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())))
-
-    @pl.when(qi == nq - 1)
-    def _emit():
-        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
-        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
-
-
-def _bwd(q, k, v, o, lse, do, scale, causal, window, block_q, block_k,
-         offset=None):
-    B, H, Sq, D = q.shape
-    Skv = k.shape[2]
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
-                    keepdims=True)  # [B,H,Sq,1]
-    delta = jnp.broadcast_to(delta, delta.shape[:-1] + (128,))
-    off = _delta_arr(offset)
-
-    dq_kernel = functools.partial(
-        _dq_kernel, scale=scale, causal=causal, window=window,
-        block_q=block_q, block_k=block_k)
-    dq = pl.pallas_call(
-        dq_kernel,
-        grid=(B, H, Sq // block_q, Skv // block_k),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, 0)),
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_q, 128), lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_q, 128), lambda b, h, qi, ki: (b, h, qi, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, 1, block_q, D),
-                               lambda b, h, qi, ki: (b, h, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
-        compiler_params=_CompilerParams(
-            dimension_semantics=("parallel", "parallel", "parallel",
-                                 "arbitrary")),
-        interpret=_interpret(),
-    )(off, q, k, v, do, lse, delta)
-
-    dkv_kernel = functools.partial(
-        _dkv_kernel, scale=scale, causal=causal, window=window,
-        block_q=block_q, block_k=block_k)
-    dk, dv = pl.pallas_call(
-        dkv_kernel,
-        grid=(B, H, Skv // block_k, Sq // block_q),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, ki, qi: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qi: (b, h, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qi: (b, h, ki, 0)),
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, ki, qi: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_q, 128), lambda b, h, ki, qi: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_q, 128), lambda b, h, ki, qi: (b, h, qi, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qi: (b, h, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qi: (b, h, ki, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((B, H, Skv, D), q.dtype),
-            jax.ShapeDtypeStruct((B, H, Skv, D), q.dtype),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((block_k, D), jnp.float32),
-            pltpu.VMEM((block_k, D), jnp.float32),
-        ],
-        compiler_params=_CompilerParams(
-            dimension_semantics=("parallel", "parallel", "parallel",
-                                 "arbitrary")),
-        interpret=_interpret(),
-    )(off, q, k, v, do, lse, delta)
-    return dq, dk, dv
-
-
-# ---------------------------------------------------------------------------
-# public entry (custom_vjp over [B,H,S,D])
-# ---------------------------------------------------------------------------
-
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_bhsd(q, k, v, scale, causal, window, block_q, block_k):
-    o, _ = _fwd(q, k, v, scale, causal, window, block_q, block_k)
-    return o
-
-
-def _flash_fwd_rule(q, k, v, scale, causal, window, block_q, block_k):
-    o, lse = _fwd(q, k, v, scale, causal, window, block_q, block_k)
-    return o, (q, k, v, o, lse)
-
-
-def _flash_bwd_rule(scale, causal, window, block_q, block_k, res, do):
-    q, k, v, o, lse = res
-    dq, dk, dv = _bwd(q, k, v, o, lse, do, scale, causal, window,
-                      block_q, block_k)
-    return dq, dk, dv
-
-
-_flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
-
-
-def supported(q_len: int, kv_len: int, block_q: int = DEFAULT_BLOCK,
-              block_k: int = DEFAULT_BLOCK) -> bool:
-    return (q_len == kv_len and q_len % block_q == 0
-            and kv_len % block_k == 0)
-
-
-def _pick_block(s: int, cap: int = 512) -> Optional[int]:
-    for b in (cap, 256, 128):
-        if b <= s and s % b == 0:
-            return b
-    return s if s % 128 == 0 else None
+def _use_splash() -> bool:
+    """Opt-in A/B baseline: route full-sequence attention through jax's
+    bundled splash kernel instead of the in-tree template (hardware
+    only — splash is the pre-template TPU path, kept for comparison
+    runs, not a supported training path: it bypasses the template's
+    custom_vjp)."""
+    return (os.environ.get("MEGATRON_TPU_SPLASH_ATTENTION", "")
+            not in ("", "0") and not _interpret())
 
 
 def _splash_attention(q, k, v, causal: bool, window: Optional[int]):
@@ -391,20 +100,14 @@ def flash_attention(
     block_q: int = DEFAULT_BLOCK,
     block_k: int = DEFAULT_BLOCK,
 ) -> jnp.ndarray:
-    """Public entry in framework layout.
-
-    Dispatch: on TPU, jax's bundled splash-attention kernel — the analogue
-    of the reference depending on the flash-attn library
-    (megatron/model/transformer.py:524-553) — covering causal, sliding
-    window (transformer.py:528-536) and GQA with grouped (not replicated)
-    K/V. The in-tree kernel above serves the CPU/interpret test path and
-    any shape splash rejects."""
-    b, sq, hq, d = q.shape
-    _, skv, hkv, _ = k.shape
-    groups = hq // hkv
-
-    if not _interpret():
-        # splash accepts any seq divisible by 128 (its own block pick)
+    """Public entry in framework layout: the template's fused fwd +
+    custom-vjp bwd (flash_template.flash_mha) on every backend —
+    interpreter mode on CPU hosts, compiled on TPU. Set
+    MEGATRON_TPU_SPLASH_ATTENTION=1 on hardware to A/B against jax's
+    bundled splash kernel instead."""
+    if _use_splash():
+        b, sq, hq, d = q.shape
+        skv = k.shape[1]
         if sq != skv or _pick_block(sq) is None:
             raise ValueError(
                 f"splash kernel needs equal seq lens divisible by 128 "
@@ -414,22 +117,5 @@ def flash_attention(
         vt = jnp.transpose(v, (0, 2, 1, 3))
         o = _splash_attention(qt, kt, vt, causal, sliding_window)
         return jnp.transpose(o, (0, 2, 1, 3))
-
-    block_q = min(block_q, sq)
-    block_k = min(block_k, skv)
-    if not supported(sq, skv, block_q, block_k):
-        raise ValueError(
-            f"flash kernel needs equal seq lens divisible by the block "
-            f"({sq=}, {skv=}, {block_q=}, {block_k=})")
-
-    qt = jnp.transpose(q, (0, 2, 1, 3))              # [B,Hq,S,D]
-    kt = jnp.transpose(k, (0, 2, 1, 3))              # [B,Hkv,S,D]
-    vt = jnp.transpose(v, (0, 2, 1, 3))
-
-    if groups > 1:
-        kt = jnp.repeat(kt, groups, axis=1)
-        vt = jnp.repeat(vt, groups, axis=1)
-    scale = float(1.0 / (d ** 0.5))
-    o = _flash_bhsd(qt, kt, vt, scale, causal, sliding_window,
-                    block_q, block_k)
-    return jnp.transpose(o, (0, 2, 1, 3))
+    return flash_mha(q, k, v, sliding_window=sliding_window, causal=causal,
+                     block_q=block_q, block_k=block_k)

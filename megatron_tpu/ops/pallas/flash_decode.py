@@ -1,261 +1,31 @@
-"""Fused flash-decode kernel: single-token attention over a slot cache.
+"""Fused flash-decode: single-token / multi-query attention over a slot
+cache — the Sq-small specialization of the one kernel family in
+flash_template.py (see that module and ops/pallas/masks.py).
 
-Serving decode is the [B, 1, Hq, D] query against a [B, S, Hkv, D] KV
+Serving decode is the [B, Sq, Hq, D] query (Sq == 1 plain decode, Sq ==
+spec k+1 for the speculative verify pass) against a [B, S, Hkv, D] KV
 cache where every batch row (slot) has its OWN valid prefix length — the
 continuous-batching engine (inference/engine.py) keeps sequences of
 different ages in one persistent cache. The dense path materializes the
-[B, H, 1, S] score row over the full cache; this kernel streams the cache
-in blocks with online-softmax accumulators (the FlashAttention-2 decode
-shape: q block = the G grouped query heads of one kv head) and SKIPS
-blocks entirely beyond the slot's valid prefix, so a young sequence in a
-long cache pays only for the context it has.
+[B, H, Sq, S] score rows over the full cache; the template instantiation
+streams the cache in blocks with online-softmax accumulators and SKIPS
+blocks entirely beyond the slot's valid prefix (and, windowed, before the
+window's lower edge), so a young sequence in a long cache pays only for
+the context it has. GQA comes free: the q tile is [Sq*G, D] — all grouped
+query heads of one kv head — so K/V are never replicated.
 
-Grid (B, Hkv, S/BK): kv axis innermost and sequential; m/l/acc scratch in
-VMEM persists across the kv steps of one (slot, kv-head) pair. Per-slot
-lengths ride in SMEM (scalar memory) and gate both the mask and the
-block-skip predicate.
-
-GQA comes free: q is reshaped to [B, Hkv, G, D] so the kernel's q tile is
-the group — K/V are never replicated across query heads.
-"""
+This module is the stable import point; the implementation lives in the
+template."""
 
 from __future__ import annotations
 
-import functools
-from typing import Optional
+from megatron_tpu.ops.pallas.flash_template import (  # noqa: F401
+    _NEG_INF,
+    _decode_kernel,
+    _interpret,
+    _pick_block,
+    flash_decode,
+    flash_decode_mq,
+)
 
-import jax
-import jax.numpy as jnp
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
-
-from megatron_tpu.ops.pallas.compat import CompilerParams as _CompilerParams
-
-_NEG_INF = float(-1e30)
-
-
-def _interpret() -> bool:
-    # interpreter mode on CPU hosts (tests/CI), hardware kernel on TPU
-    return jax.default_backend() == "cpu"
-
-
-def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_scr, l_scr, acc_scr,
-                   *, scale: float, window: Optional[int], block_k: int,
-                   groups: int):
-    b = pl.program_id(0)
-    ki = pl.program_id(2)
-    nk = pl.num_programs(2)
-    kv_len = lens_ref[b]
-
-    @pl.when(ki == 0)
-    def _init():
-        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
-        l_scr[:] = jnp.zeros_like(l_scr)
-        acc_scr[:] = jnp.zeros_like(acc_scr)
-
-    # flash-decode over the valid prefix only: blocks past the slot's
-    # length never load/compute (a fresh slot in a long cache is cheap)
-    @pl.when(ki * block_k < kv_len)
-    def _compute():
-        q = q_ref[0, 0].astype(jnp.float32) * scale      # [G, D]
-        k = k_ref[0, 0].astype(jnp.float32)              # [BK, D]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [G, BK]
-
-        k_pos = ki * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (groups, block_k), 1)
-        allowed = k_pos < kv_len
-        if window is not None:
-            # Mistral semantics: the newest position (kv_len - 1) sees at
-            # most the last `window` positions
-            allowed &= k_pos >= kv_len - window
-        s = jnp.where(allowed, s, _NEG_INF)
-
-        m_prev = m_scr[:]                                # [G, 1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.where(allowed, jnp.exp(s - m_new), 0.0)
-        alpha = jnp.exp(m_prev - m_new)
-        v = v_ref[0, 0].astype(jnp.float32)              # [BK, D]
-        pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())))
-        acc_scr[:] = acc_scr[:] * alpha + pv
-        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
-        m_scr[:] = m_new
-
-    @pl.when(ki == nk - 1)
-    def _emit():
-        l = jnp.maximum(l_scr[:], 1e-30)
-        o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
-
-
-def _pick_block(s: int, cap: int = 512) -> Optional[int]:
-    for b in (cap, 256, 128):
-        if b <= s and s % b == 0:
-            return b
-    return s if s % 128 == 0 else None
-
-
-def _mq_decode_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref,
-                      m_scr, l_scr, acc_scr,
-                      *, scale: float, window: Optional[int], block_k: int,
-                      groups: int, sq: int):
-    """Multi-query variant of _decode_kernel: the q tile is the Sq
-    speculative query rows x G grouped heads of one kv head, flattened
-    to [Sq*G, D]. Row r's query index is r // G, and query j at row b
-    sees k_pos < kv_lengths[b] + j (the speculative verify mask —
-    each query one position deeper than the last)."""
-    b = pl.program_id(0)
-    ki = pl.program_id(2)
-    nk = pl.num_programs(2)
-    kv_len = lens_ref[b]
-    R = sq * groups
-
-    @pl.when(ki == 0)
-    def _init():
-        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
-        l_scr[:] = jnp.zeros_like(l_scr)
-        acc_scr[:] = jnp.zeros_like(acc_scr)
-
-    # the deepest query (sq - 1) sees up to kv_len + sq - 2, so blocks
-    # past that never load/compute
-    @pl.when(ki * block_k < kv_len + sq - 1)
-    def _compute():
-        q = q_ref[0, 0].astype(jnp.float32) * scale      # [R, D]
-        k = k_ref[0, 0].astype(jnp.float32)              # [BK, D]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [R, BK]
-
-        k_pos = ki * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (R, block_k), 1)
-        q_idx = jax.lax.broadcasted_iota(jnp.int32, (R, block_k), 0) // groups
-        allowed = k_pos < kv_len + q_idx
-        if window is not None:
-            allowed &= k_pos >= kv_len + q_idx - window
-        s = jnp.where(allowed, s, _NEG_INF)
-
-        m_prev = m_scr[:]                                # [R, 1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.where(allowed, jnp.exp(s - m_new), 0.0)
-        alpha = jnp.exp(m_prev - m_new)
-        v = v_ref[0, 0].astype(jnp.float32)              # [BK, D]
-        pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())))
-        acc_scr[:] = acc_scr[:] * alpha + pv
-        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
-        m_scr[:] = m_new
-
-    @pl.when(ki == nk - 1)
-    def _emit():
-        l = jnp.maximum(l_scr[:], 1e-30)
-        o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
-
-
-def flash_decode_mq(
-    q: jnp.ndarray,            # [B, Sq, Hq, D] (Sq = spec k+1 query rows)
-    k: jnp.ndarray,            # [B, S, Hkv, D]
-    v: jnp.ndarray,            # [B, S, Hkv, D]
-    kv_lengths: jnp.ndarray,   # [B] int32, FIRST query's visible prefix
-    sliding_window: Optional[int] = None,
-    block_k: int = 256,
-) -> jnp.ndarray:
-    """Multi-query decode attention with per-row valid-prefix masking
-    (the speculative verify pass: query j sees k_pos < kv_lengths + j).
-    Returns [B, Sq, Hq, D]. Raises ValueError for unsupported shapes
-    (the attention() dispatcher falls back to the masked einsum)."""
-    b, sq, hq, d = q.shape
-    _, skv, hkv, _ = k.shape
-    if hq % hkv:
-        raise ValueError(f"query heads {hq} not a multiple of kv heads {hkv}")
-    blk = min(block_k, _pick_block(skv) or 0)
-    if not blk or skv % blk:
-        raise ValueError(
-            f"flash_decode_mq needs cache length divisible by 128 ({skv=})")
-    groups = hq // hkv
-    R = sq * groups
-
-    # [B, Sq, Hkv, G, D] -> [B, Hkv, Sq*G, D]: the q tile is all Sq
-    # queries' grouped heads of one kv head
-    qt = q.reshape(b, sq, hkv, groups, d).transpose(0, 2, 1, 3, 4)
-    qt = qt.reshape(b, hkv, R, d)
-    kt = jnp.transpose(k, (0, 2, 1, 3))                  # [B, Hkv, S, D]
-    vt = jnp.transpose(v, (0, 2, 1, 3))
-    lens = jnp.asarray(kv_lengths, jnp.int32)
-
-    kernel = functools.partial(
-        _mq_decode_kernel, scale=float(1.0 / (d ** 0.5)),
-        window=sliding_window, block_k=blk, groups=groups, sq=sq)
-    o = pl.pallas_call(
-        kernel,
-        grid=(b, hkv, skv // blk),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1, R, d), lambda bi, h, ki: (bi, h, 0, 0)),
-            pl.BlockSpec((1, 1, blk, d), lambda bi, h, ki: (bi, h, ki, 0)),
-            pl.BlockSpec((1, 1, blk, d), lambda bi, h, ki: (bi, h, ki, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, 1, R, d),
-                               lambda bi, h, ki: (bi, h, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, hkv, R, d), q.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((R, 1), jnp.float32),
-            pltpu.VMEM((R, 1), jnp.float32),
-            pltpu.VMEM((R, d), jnp.float32),
-        ],
-        compiler_params=_CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
-        interpret=_interpret(),
-    )(lens, qt, kt, vt)
-    return o.reshape(b, hkv, sq, groups, d).transpose(0, 2, 1, 3, 4
-                                                      ).reshape(b, sq, hq, d)
-
-
-def flash_decode(
-    q: jnp.ndarray,            # [B, 1, Hq, D]
-    k: jnp.ndarray,            # [B, S, Hkv, D]
-    v: jnp.ndarray,            # [B, S, Hkv, D]
-    kv_lengths: jnp.ndarray,   # [B] int32, valid prefix per row
-    sliding_window: Optional[int] = None,
-    block_k: int = 256,
-) -> jnp.ndarray:
-    """Single-token decode attention with per-row valid-prefix masking.
-    Returns [B, 1, Hq, D]. Raises ValueError for unsupported shapes (the
-    attention() dispatcher falls back to the masked-einsum path)."""
-    b, sq, hq, d = q.shape
-    _, skv, hkv, _ = k.shape
-    if sq != 1:
-        raise ValueError(f"flash_decode is single-token only (q_len={sq})")
-    if hq % hkv:
-        raise ValueError(f"query heads {hq} not a multiple of kv heads {hkv}")
-    blk = min(block_k, _pick_block(skv) or 0)
-    if not blk or skv % blk:
-        raise ValueError(
-            f"flash_decode needs cache length divisible by 128 ({skv=})")
-    groups = hq // hkv
-
-    qt = q.reshape(b, 1, hkv, groups, d).squeeze(1)      # [B, Hkv, G, D]
-    kt = jnp.transpose(k, (0, 2, 1, 3))                  # [B, Hkv, S, D]
-    vt = jnp.transpose(v, (0, 2, 1, 3))
-    lens = jnp.asarray(kv_lengths, jnp.int32)
-
-    kernel = functools.partial(
-        _decode_kernel, scale=float(1.0 / (d ** 0.5)),
-        window=sliding_window, block_k=blk, groups=groups)
-    o = pl.pallas_call(
-        kernel,
-        grid=(b, hkv, skv // blk),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1, groups, d), lambda bi, h, ki: (bi, h, 0, 0)),
-            pl.BlockSpec((1, 1, blk, d), lambda bi, h, ki: (bi, h, ki, 0)),
-            pl.BlockSpec((1, 1, blk, d), lambda bi, h, ki: (bi, h, ki, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, 1, groups, d),
-                               lambda bi, h, ki: (bi, h, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, hkv, groups, d), q.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((groups, 1), jnp.float32),
-            pltpu.VMEM((groups, 1), jnp.float32),
-            pltpu.VMEM((groups, d), jnp.float32),
-        ],
-        compiler_params=_CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
-        interpret=_interpret(),
-    )(lens, qt, kt, vt)
-    return o.reshape(b, 1, hq, d)
+__all__ = ["flash_decode", "flash_decode_mq"]

@@ -1,0 +1,663 @@
+"""One FlashAttention-2 Pallas kernel family.
+
+Every attention path in the repo — training/prefill forward AND backward,
+single-token decode, speculative multi-query decode, paged decode — is an
+instantiation of the one template in this module, with four knobs:
+
+  knob          | values                  | what it changes
+  --------------|-------------------------|------------------------------------
+  work shape    | prefill / decode        | prefill: grid (B, Hq, Sq/BQ, Skv/BK),
+                |                         | q tile [BQ, D] (FA-2 partitioning:
+                |                         | parallel over Sq blocks and heads, kv
+                |                         | axis innermost+sequential); decode:
+                |                         | grid (B, Hkv, Skv/BK), q tile
+                |                         | [Sq*G, D] (the Sq-small
+                |                         | specialization — all of one kv
+                |                         | head's grouped queries ride in one
+                |                         | MXU tile, K/V never replicated)
+  mask          | causal / bidirectional, | ops/pallas/masks.py: ONE position
+                | sliding window,         | model supplies the element mask and
+                | kv_lengths (decode)     | the block-skip predicate for every
+                |                         | instantiation
+  paging        | dense / page table      | the page table rides in as a
+                |                         | scalar-prefetch operand; BlockSpec
+                |                         | index maps dereference it at
+                |                         | DMA-issue time (no dense gather)
+  gradient      | fwd-only / custom_vjp   | the FA-2 recompute backward: fwd
+                |                         | saves lse, bwd recomputes p from
+                |                         | (q, k, lse), one kernel accumulates
+                |                         | dq over kv blocks, one dk/dv over q
+                |                         | blocks
+
+Online softmax (running max m, running sum l, unnormalized acc in VMEM
+scratch persisting across the sequential kv steps) is shared by every
+instantiation, as is the block-skip: a kv tile outside the visible band of
+the tile's queries (masks.block_live) never loads or computes, so causal
+prefill pays ~half the tiles and a young decode slot in a long cache pays
+only for the context it has.
+
+Layouts: public entries take the framework-native [B, S, H, D]; kernels
+run on [B, H, S, D] so the (S, D) tile is MXU-facing. Kernels run in
+interpreter mode on CPU hosts (tests/CI) and compile for real on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from megatron_tpu.ops.pallas import masks
+from megatron_tpu.ops.pallas.compat import CompilerParams as _CompilerParams
+
+DEFAULT_BLOCK = 256
+_NEG_INF = masks.NEG_INF
+
+
+def _interpret() -> bool:
+    # Pallas TPU kernels run in interpreter mode on CPU hosts (tests/CI)
+    return jax.default_backend() == "cpu"
+
+
+def interpret_forced() -> bool:
+    """True when the dispatcher should use the kernels EVEN on a CPU host
+    (interpreter mode — orders of magnitude slower than fused XLA, so
+    only tests/bench set this; see ops/attention.py)."""
+    return os.environ.get("MEGATRON_TPU_FLASH_INTERPRET", "") not in ("", "0")
+
+
+def _pick_block(s: int, cap: int = 512) -> Optional[int]:
+    for b in (cap, 256, 128):
+        if b <= s and s % b == 0:
+            return b
+    return s if s % 128 == 0 else None
+
+
+def supported(q_len: int, kv_len: int, block_q: int = DEFAULT_BLOCK,
+              block_k: int = DEFAULT_BLOCK) -> bool:
+    return (q_len == kv_len and q_len % block_q == 0
+            and kv_len % block_k == 0)
+
+
+# ---------------------------------------------------------------------------
+# prefill/training forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(delta_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr,
+                *, scale: float, causal: bool, window: Optional[int],
+                block_q: int, block_k: int):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+    delta = delta_ref[0]
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # FA-2 block-skip: tiles outside the visible band (beyond the causal
+    # frontier / before the window's lower edge) never compute
+    @pl.when(masks.prefill_block_live(qi, ki, block_q, block_k,
+                                      causal=causal, window=window,
+                                      delta=delta))
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale     # [BQ, D]
+        k = k_ref[0, 0].astype(jnp.float32)             # [BK, D]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [BQ, BK]
+
+        q_pos, k_pos = masks.prefill_positions(qi, ki, block_q, block_k,
+                                               delta)
+        mask = masks.visible(q_pos, k_pos, causal=causal, window=window)
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_scr[:]                                # [BQ, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        v = v_ref[0, 0].astype(jnp.float32)              # [BK, D]
+        pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())))  # [BQ, D]
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m_scr[:] = m_new
+        l_scr[:] = l_new
+
+    @pl.when(ki == nk - 1)
+    def _emit():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        # lane-padded to 128: [..., 1]-shaped outputs get tiled to 128 lanes
+        # anyway, and the narrow layout trips XLA's scoped-vmem stack
+        # allocation for custom-call outputs (observed on v5e)
+        lse_ref[0, 0] = jnp.broadcast_to(m_scr[:] + jnp.log(l),
+                                         lse_ref.shape[2:])
+
+
+def _delta_arr(delta):
+    """Scalar global-position offset -> [1] int32 SMEM operand."""
+    if delta is None:
+        return jnp.zeros((1,), jnp.int32)
+    return jnp.asarray(delta, jnp.int32).reshape(1)
+
+
+def _fwd(q, k, v, scale, causal, window, block_q, block_k, delta=None):
+    """q [B,Hq,Sq,D], k/v [B,Hq,Skv,D] (kv already group-broadcast).
+    Returns (o [B,Hq,Sq,D], lse [B,Hq,Sq]). delta: traced q-vs-k global
+    position offset (ring stripes); None = aligned."""
+    B, H, Sq, D = q.shape
+    Skv = k.shape[2]
+    grid = (B, H, Sq // block_q, Skv // block_k)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, window=window,
+        block_q=block_q, block_k=block_k)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 128), lambda b, h, qi, ki: (b, h, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Sq, 128), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=_interpret(),
+    )(_delta_arr(delta), q, k, v)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# prefill/training backward (FA-2 recompute scheme)
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, dq_scr,
+               *, scale: float, causal: bool, window: Optional[int],
+               block_q: int, block_k: int):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+    off = off_ref[0]
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    @pl.when(masks.prefill_block_live(qi, ki, block_q, block_k,
+                                      causal=causal, window=window,
+                                      delta=off))
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, 0:1]                      # [BQ, 1]
+        delta = delta_ref[0, 0][:, 0:1]                  # [BQ, 1]
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))
+        q_pos, k_pos = masks.prefill_positions(qi, ki, block_q, block_k, off)
+        mask = masks.visible(q_pos, k_pos, causal=causal, window=window)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)       # softmax probs
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))  # [BQ, BK]
+        ds = p * (dp - delta)
+        dq_scr[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ()))) * scale
+
+    @pl.when(ki == nk - 1)
+    def _emit():
+        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr,
+                *, scale: float, causal: bool, window: Optional[int],
+                block_q: int, block_k: int):
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+    nq = pl.num_programs(3)
+    off = off_ref[0]
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    @pl.when(masks.prefill_block_live(qi, ki, block_q, block_k,
+                                      causal=causal, window=window,
+                                      delta=off))
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, 0:1]
+        delta = delta_ref[0, 0][:, 0:1]
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))
+        q_pos, k_pos = masks.prefill_positions(qi, ki, block_q, block_k, off)
+        mask = masks.visible(q_pos, k_pos, causal=causal, window=window)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)       # [BQ, BK]
+        dv_scr[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())))
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))
+        ds = p * (dp - delta)
+        # q was pre-scaled on load, so this dot already carries the 1/sqrt(d)
+        dk_scr[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())))
+
+    @pl.when(qi == nq - 1)
+    def _emit():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd(q, k, v, o, lse, do, scale, causal, window, block_q, block_k,
+         offset=None):
+    B, H, Sq, D = q.shape
+    Skv = k.shape[2]
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
+                    keepdims=True)  # [B,H,Sq,1]
+    delta = jnp.broadcast_to(delta, delta.shape[:-1] + (128,))
+    off = _delta_arr(offset)
+
+    dq_kernel = functools.partial(
+        _dq_kernel, scale=scale, causal=causal, window=window,
+        block_q=block_q, block_k=block_k)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(B, H, Sq // block_q, Skv // block_k),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 128), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 128), lambda b, h, qi, ki: (b, h, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D),
+                               lambda b, h, qi, ki: (b, h, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=_interpret(),
+    )(off, q, k, v, do, lse, delta)
+
+    dkv_kernel = functools.partial(
+        _dkv_kernel, scale=scale, causal=causal, window=window,
+        block_q=block_q, block_k=block_k)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(B, H, Skv // block_k, Sq // block_q),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, ki, qi: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qi: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qi: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, ki, qi: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 128), lambda b, h, ki, qi: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 128), lambda b, h, ki, qi: (b, h, qi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qi: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qi: (b, h, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Skv, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Skv, D), q.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=_interpret(),
+    )(off, q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp over [B,H,S,D]: the training fwd+bwd instantiation
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_bhsd(q, k, v, scale, causal, window, block_q, block_k):
+    o, _ = _fwd(q, k, v, scale, causal, window, block_q, block_k)
+    return o
+
+
+def _flash_fwd_rule(q, k, v, scale, causal, window, block_q, block_k):
+    o, lse = _fwd(q, k, v, scale, causal, window, block_q, block_k)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd_rule(scale, causal, window, block_q, block_k, res, do):
+    q, k, v, o, lse = res
+    dq, dk, dv = _bwd(q, k, v, o, lse, do, scale, causal, window,
+                      block_q, block_k)
+    return dq, dk, dv
+
+
+_flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_mha(
+    q: jnp.ndarray,  # [B, Sq, Hq, D]
+    k: jnp.ndarray,  # [B, Skv, Hkv, D]
+    v: jnp.ndarray,
+    sliding_window: Optional[int] = None,
+    causal: bool = True,
+    block_q: int = DEFAULT_BLOCK,
+    block_k: int = DEFAULT_BLOCK,
+) -> jnp.ndarray:
+    """The training/prefill instantiation in framework layout: fused
+    forward + the FA-2 recompute backward via custom_vjp — jax.grad
+    through this never builds the XLA O(S^2) gradient. GQA broadcasts
+    K/V per group (dk/dv group-sum falls out of the broadcast's own
+    vjp). Raises ValueError for geometries the template doesn't cover
+    (the attention() dispatcher falls back loudly)."""
+    b, sq, hq, d = q.shape
+    _, skv, hkv, _ = k.shape
+    groups = hq // hkv
+    block_q = min(block_q, sq)
+    block_k = min(block_k, skv)
+    if not supported(sq, skv, block_q, block_k):
+        raise ValueError(
+            f"flash kernel needs equal seq lens divisible by the block "
+            f"({sq=}, {skv=}, {block_q=}, {block_k=})")
+    if not _interpret() and (block_q % 128 or block_k % 128):
+        # hardware tiles want lane-aligned blocks; the interpreter (CPU
+        # tests) accepts any divisor so small geometries stay testable
+        raise ValueError(
+            f"flash kernel needs blocks divisible by 128 on hardware "
+            f"({block_q=}, {block_k=})")
+
+    qt = jnp.transpose(q, (0, 2, 1, 3))              # [B,Hq,S,D]
+    kt = jnp.transpose(k, (0, 2, 1, 3))              # [B,Hkv,S,D]
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    if groups > 1:
+        kt = jnp.repeat(kt, groups, axis=1)
+        vt = jnp.repeat(vt, groups, axis=1)
+    scale = float(1.0 / (d ** 0.5))
+    o = _flash_bhsd(qt, kt, vt, scale, causal, sliding_window,
+                    block_q, block_k)
+    return jnp.transpose(o, (0, 2, 1, 3))
+
+
+# ---------------------------------------------------------------------------
+# decode: the Sq-small specialization
+# ---------------------------------------------------------------------------
+
+
+def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr,
+                   *, scale: float, window: Optional[int], block_k: int,
+                   groups: int, sq: int):
+    """ONE body for all four decode instantiations (single/multi-query x
+    dense/paged). The q tile is the Sq speculative query rows x G
+    grouped heads of one kv head, flattened to [Sq*G, D] (sq == 1 is
+    plain decode: the tile is just the G grouped heads). Row r is
+    speculative query r // G at global position kv_len - 1 + r // G;
+    masks.py turns those positions into the element mask and the
+    block-skip predicate. The paged variant reuses this body unchanged —
+    page resolution happens in the BlockSpec index maps, queries never
+    see it."""
+    b = pl.program_id(0)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+    kv_len = lens_ref[b]
+    rows = sq * groups
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # blocks past the deepest query's frontier (kv_len + sq - 2) — or,
+    # windowed, entirely before the shallowest query's window — never
+    # load/compute: a young slot in a long cache is cheap, and
+    # scratch-mapped unallocated page-table entries are skipped the same
+    # way
+    @pl.when(masks.decode_block_live(ki, block_k, kv_len, sq,
+                                     window=window))
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale      # [rows, D]
+        k = k_ref[0, 0].astype(jnp.float32)              # [BK, D]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))
+
+        q_pos, k_pos = masks.decode_positions(ki, block_k, kv_len,
+                                              groups, rows)
+        allowed = masks.visible(q_pos, k_pos, causal=True, window=window)
+        s = jnp.where(allowed, s, _NEG_INF)
+
+        m_prev = m_scr[:]                                # [rows, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.where(allowed, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        v = v_ref[0, 0].astype(jnp.float32)              # [BK, D]
+        pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())))
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        m_scr[:] = m_new
+
+    @pl.when(ki == nk - 1)
+    def _emit():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+def _with_page_table(kernel):
+    """Adapt the decode body to the scalar-prefetch calling convention:
+    the page table rides as the second prefetch operand for the
+    BlockSpec index maps, but the body itself never reads it."""
+    def paged_kernel(lens_ref, pt_ref, *rest):
+        kernel(lens_ref, *rest)
+    return paged_kernel
+
+
+def _decode_call(q, k, v, kv_lengths, *, window: Optional[int], blk: int,
+                 page_table=None):
+    """Shared launch for the decode specialization. Dense: k/v
+    [B, Skv, Hkv, D], blk = kv block. Paged: k/v are the page pools
+    [P, ps, Hkv, D], blk = page size, one page per grid step."""
+    b, sq, hq, d = q.shape
+    hkv = k.shape[2]
+    groups = hq // hkv
+    rows = sq * groups
+
+    # [B, Sq, Hkv, G, D] -> [B, Hkv, Sq*G, D]: the q tile is all Sq
+    # queries' grouped heads of one kv head
+    qt = q.reshape(b, sq, hkv, groups, d).transpose(0, 2, 1, 3, 4)
+    qt = qt.reshape(b, hkv, rows, d)
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    lens = jnp.asarray(kv_lengths, jnp.int32)
+
+    kernel = functools.partial(
+        _decode_kernel, scale=float(1.0 / (d ** 0.5)),
+        window=window, block_k=blk, groups=groups, sq=sq)
+    scratch_shapes = [
+        pltpu.VMEM((rows, 1), jnp.float32),
+        pltpu.VMEM((rows, 1), jnp.float32),
+        pltpu.VMEM((rows, d), jnp.float32),
+    ]
+
+    if page_table is None:
+        skv = k.shape[1]
+        o = pl.pallas_call(
+            kernel,
+            grid=(b, hkv, skv // blk),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec((1, 1, rows, d), lambda bi, h, ki: (bi, h, 0, 0)),
+                pl.BlockSpec((1, 1, blk, d), lambda bi, h, ki: (bi, h, ki, 0)),
+                pl.BlockSpec((1, 1, blk, d), lambda bi, h, ki: (bi, h, ki, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, rows, d),
+                                   lambda bi, h, ki: (bi, h, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((b, hkv, rows, d), q.dtype),
+            scratch_shapes=scratch_shapes,
+            compiler_params=_CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")),
+            interpret=_interpret(),
+        )(lens, qt, kt, vt)
+    else:
+        table = jnp.asarray(page_table, jnp.int32)
+        max_pages = table.shape[1]
+        # scalar-prefetch index maps: (grid indices..., lens_ref, pt_ref)
+        # -> block indices; the kv maps dereference the page table so the
+        # DMA fetches the slot's physical page for this logical block
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, hkv, max_pages),
+            in_specs=[
+                pl.BlockSpec((1, 1, rows, d),
+                             lambda bi, h, ki, lens, pt: (bi, h, 0, 0)),
+                pl.BlockSpec((1, 1, blk, d),
+                             lambda bi, h, ki, lens, pt: (pt[bi, ki], h, 0, 0)),
+                pl.BlockSpec((1, 1, blk, d),
+                             lambda bi, h, ki, lens, pt: (pt[bi, ki], h, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, rows, d),
+                                   lambda bi, h, ki, lens, pt: (bi, h, 0, 0)),
+            scratch_shapes=scratch_shapes,
+        )
+        o = pl.pallas_call(
+            _with_page_table(kernel),
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((b, hkv, rows, d), q.dtype),
+            interpret=_interpret(),
+        )(lens, table, qt, kt, vt)
+    return o.reshape(b, hkv, sq, groups, d).transpose(0, 2, 1, 3, 4
+                                                      ).reshape(b, sq, hq, d)
+
+
+def _check_heads(hq: int, hkv: int) -> None:
+    if hq % hkv:
+        raise ValueError(f"query heads {hq} not a multiple of kv heads {hkv}")
+
+
+def flash_decode_mq(
+    q: jnp.ndarray,            # [B, Sq, Hq, D] (Sq = spec k+1 query rows)
+    k: jnp.ndarray,            # [B, S, Hkv, D]
+    v: jnp.ndarray,            # [B, S, Hkv, D]
+    kv_lengths: jnp.ndarray,   # [B] int32, FIRST query's visible prefix
+    sliding_window: Optional[int] = None,
+    block_k: int = 256,
+) -> jnp.ndarray:
+    """Multi-query decode attention with per-row valid-prefix masking
+    (the speculative verify pass: query j sees k_pos < kv_lengths + j).
+    Returns [B, Sq, Hq, D]. Raises ValueError for unsupported shapes
+    (the attention() dispatcher falls back to the masked einsum)."""
+    b, sq, hq, d = q.shape
+    _, skv, hkv, _ = k.shape
+    _check_heads(hq, hkv)
+    blk = min(block_k, _pick_block(skv) or 0)
+    if not blk or skv % blk:
+        raise ValueError(
+            f"flash_decode_mq needs cache length divisible by 128 ({skv=})")
+    return _decode_call(q, k, v, kv_lengths, window=sliding_window, blk=blk)
+
+
+def flash_decode(
+    q: jnp.ndarray,            # [B, 1, Hq, D]
+    k: jnp.ndarray,            # [B, S, Hkv, D]
+    v: jnp.ndarray,            # [B, S, Hkv, D]
+    kv_lengths: jnp.ndarray,   # [B] int32, valid prefix per row
+    sliding_window: Optional[int] = None,
+    block_k: int = 256,
+) -> jnp.ndarray:
+    """Single-token decode attention with per-row valid-prefix masking:
+    the sq == 1 point of the decode specialization. Returns
+    [B, 1, Hq, D]. Raises ValueError for unsupported shapes (the
+    attention() dispatcher falls back to the masked-einsum path)."""
+    b, sq, hq, d = q.shape
+    _, skv, hkv, _ = k.shape
+    if sq != 1:
+        raise ValueError(f"flash_decode is single-token only (q_len={sq})")
+    _check_heads(hq, hkv)
+    blk = min(block_k, _pick_block(skv) or 0)
+    if not blk or skv % blk:
+        raise ValueError(
+            f"flash_decode needs cache length divisible by 128 ({skv=})")
+    return _decode_call(q, k, v, kv_lengths, window=sliding_window, blk=blk)
+
+
+def _check_paged(q, k_pages, page_table, name: str) -> None:
+    b = q.shape[0]
+    ps = k_pages.shape[1]
+    _check_heads(q.shape[2], k_pages.shape[2])
+    if ps % 8:
+        # TPU sublane alignment for the [ps, D] kv tile; the gather
+        # fallback covers exotic page sizes
+        raise ValueError(f"page_size {ps} must be a multiple of 8")
+    if page_table.shape[0] != b:
+        raise ValueError(
+            f"page_table rows {page_table.shape[0]} != batch {b}")
+
+
+def paged_flash_decode_mq(
+    q: jnp.ndarray,            # [B, Sq, Hq, D] (Sq = spec k+1 query rows)
+    k_pages: jnp.ndarray,      # [P, ps, Hkv, D] shared page pool
+    v_pages: jnp.ndarray,      # [P, ps, Hkv, D]
+    page_table: jnp.ndarray,   # [B, max_pages] int32
+    kv_lengths: jnp.ndarray,   # [B] int32, FIRST query's visible prefix
+    sliding_window: Optional[int] = None,
+) -> jnp.ndarray:
+    """Multi-query decode attention over paged KV (the speculative
+    verify pass) — the paged knob of the decode specialization. Returns
+    [B, Sq, Hq, D]; ValueError for unsupported shapes (the attention()
+    dispatcher falls back to the gather + masked einsum)."""
+    _check_paged(q, k_pages, page_table, "paged_flash_decode_mq")
+    return _decode_call(q, k_pages, v_pages, kv_lengths,
+                        window=sliding_window, blk=k_pages.shape[1],
+                        page_table=page_table)
+
+
+def paged_flash_decode(
+    q: jnp.ndarray,            # [B, 1, Hq, D]
+    k_pages: jnp.ndarray,      # [P, ps, Hkv, D] shared page pool
+    v_pages: jnp.ndarray,      # [P, ps, Hkv, D]
+    page_table: jnp.ndarray,   # [B, max_pages] int32 physical page per block
+    kv_lengths: jnp.ndarray,   # [B] int32, valid prefix per row
+    sliding_window: Optional[int] = None,
+) -> jnp.ndarray:
+    """Single-token decode attention over paged KV with per-row prefix
+    masking. Returns [B, 1, Hq, D]. Raises ValueError for unsupported
+    shapes (the attention() dispatcher falls back to the gather +
+    masked-einsum path)."""
+    if q.shape[1] != 1:
+        raise ValueError(
+            f"paged_flash_decode is single-token only (q_len={q.shape[1]})")
+    _check_paged(q, k_pages, page_table, "paged_flash_decode")
+    return _decode_call(q, k_pages, v_pages, kv_lengths,
+                        window=sliding_window, blk=k_pages.shape[1],
+                        page_table=page_table)

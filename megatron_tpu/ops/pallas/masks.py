@@ -1,0 +1,143 @@
+"""Block-visibility predicates shared by every flash-kernel instantiation.
+
+Every attention variant in ops/pallas/ answers the same two questions per
+(query tile, kv tile) pair, and before this module each kernel answered
+them with its own copy of the arithmetic:
+
+  1. element mask — which (q, k) pairs inside the tile are visible?
+  2. block skip  — can the whole kv tile be skipped without loading it?
+
+Both reduce to ONE position model. Assign every query row a global
+position ``q_pos`` and every key column a global position ``k_pos``; then
+
+  * causal visibility is ``k_pos <= q_pos``;
+  * a Mistral sliding window of width W is ``k_pos > q_pos - W``
+    (the newest W positions, self included);
+
+and the per-variant differences are only in how positions are assigned:
+
+  * prefill/training tiles: ``q_pos = qi*BQ + row (+ delta)``,
+    ``k_pos = ki*BK + col`` — ``delta`` is the q-vs-k global offset the
+    ring-attention stripes thread through SMEM;
+  * decode (the Sq-small specialization): query row r of a slot with
+    valid prefix ``kv_len`` is speculative query ``j = r // G`` (G =
+    grouped heads per kv head) sitting at ``q_pos = kv_len - 1 + j``;
+    ``k_pos`` indexes the cache. The "kv_lengths mask"
+    ``k_pos < kv_len + j`` IS the causal rule at those positions — not a
+    separate mask family.
+
+The block-skip predicates are the interval form of the same rule: a kv
+tile is live iff it intersects the union of visible bands of the tile's
+queries, ``(q_lo - W, q_hi]``. All functions accept traced values (SMEM
+scalars inside kernels) and Python ints / numpy arrays (the dense
+reference the unit tests check against) alike.
+
+Everything is kept 2-D in-kernel: 1-D iota lowers to scalar code on TPU,
+so the iota helpers emit [rows, cols] grids directly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+#: Finite -inf stand-in: subtracting it from itself must stay finite in
+#: the online-softmax update (a true -inf would produce NaN via inf-inf),
+#: and downstream consumers (ring merge) treat <= NEG_INF/2 as "row saw
+#: nothing".
+NEG_INF = float(-1e30)
+
+
+# ---------------------------------------------------------------------------
+# element-level visibility (the one mask rule)
+# ---------------------------------------------------------------------------
+
+
+def visible(q_pos, k_pos, *, causal: bool = True,
+            window: Optional[int] = None):
+    """Element visibility of key position(s) to query position(s).
+
+    Works on traced 2-D iota grids inside kernels and on numpy/int
+    arguments in tests — this function IS the dense reference the unit
+    tests prove the block predicates against."""
+    m = (k_pos <= q_pos) if causal else (k_pos == k_pos)
+    if window is not None:
+        m = m & (k_pos > q_pos - window)
+    return m
+
+
+def prefill_positions(qi, ki, block_q: int, block_k: int, delta=0):
+    """(q_pos, k_pos) [BQ, BK] grids for a prefill/training tile pair.
+
+    delta (may be a traced SMEM scalar): global offset q_global -
+    k_global of the two tiles' origins. Ring attention uses it so ONE
+    kernel covers every stripe pair — aligned diagonal (delta 0),
+    fully-past (delta >= stripe) and shifted sliding-window bands."""
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0) + delta
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return q_pos, k_pos
+
+
+def decode_positions(ki, block_k: int, kv_len, groups: int, rows: int):
+    """(q_pos, k_pos) [rows, BK] grids for a decode tile.
+
+    rows = Sq * groups: row r is speculative query j = r // groups of
+    this slot, at global position kv_len - 1 + j (the verify pass —
+    each query one position deeper than the last; Sq == 1 is plain
+    single-token decode). kv_len may be a traced SMEM scalar."""
+    q_idx = jax.lax.broadcasted_iota(jnp.int32, (rows, block_k), 0) // groups
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (rows, block_k), 1)
+    return kv_len - 1 + q_idx, k_pos
+
+
+# ---------------------------------------------------------------------------
+# block-level skip predicates (the interval form)
+# ---------------------------------------------------------------------------
+
+
+def block_live(ki, block_k: int, q_lo, q_hi, *, causal: bool = True,
+               window: Optional[int] = None):
+    """True iff kv tile ki ([ki*BK, (ki+1)*BK)) contains ANY position
+    visible to queries spanning global positions [q_lo, q_hi].
+
+    The union of the queries' visible bands is (q_lo - W, q_hi] (causal
+    upper edge from the deepest query, window lower edge from the
+    shallowest), so the tile is live iff it intersects that interval:
+
+      causal edge: ki*BK <= q_hi
+      window edge: (ki+1)*BK - 1 > q_lo - W
+
+    Equality with the dense reference (ANY over `visible` on the tile's
+    columns) is unit-tested for every edge, including the decode
+    ``kv_len + Sq - 1`` boundary and the window lower edge."""
+    live = (ki * block_k <= q_hi) if causal else (ki == ki)
+    if window is not None:
+        live = live & ((ki + 1) * block_k - 1 > q_lo - window)
+    return live
+
+
+def decode_block_live(ki, block_k: int, kv_len, sq: int, *,
+                      window: Optional[int] = None):
+    """Block-skip predicate for the decode specialization: queries span
+    [kv_len - 1, kv_len + sq - 2], so the causal edge is
+    ``ki*BK < kv_len + sq - 1`` (the historical mq boundary) and the
+    window edge is ``(ki+1)*BK > kv_len - W``. Blocks past a young
+    slot's prefix (or scratch-mapped unallocated pages) never
+    load/compute."""
+    return block_live(ki, block_k, kv_len - 1, kv_len + sq - 2,
+                      causal=True, window=window)
+
+
+def prefill_block_live(qi, ki, block_q: int, block_k: int, *,
+                       causal: bool = True, window: Optional[int] = None,
+                       delta=0):
+    """Block-skip predicate for a prefill/training tile pair: queries
+    span [qi*BQ + delta, qi*BQ + BQ - 1 + delta]."""
+    return block_live(ki, block_k, qi * block_q + delta,
+                      qi * block_q + block_q - 1 + delta,
+                      causal=causal, window=window)

@@ -1,275 +1,34 @@
-"""Paged flash-decode kernel: single-token attention through a page table.
+"""Paged flash-decode: decode attention through a page table — the paged
+knob of the one kernel family in flash_template.py (see that module and
+ops/pallas/masks.py).
 
 The paged serving engine (inference/paging/) stores KV in a shared pool of
 fixed-size pages — [num_pages, page_size, Hkv, D] per layer — and each
 slot's logical context is a row of page indices. The dense flash-decode
-kernel (flash_decode.py) streams a CONTIGUOUS [B, S, ...] cache; this
-variant streams the same online-softmax blocks but resolves each kv block
-through the page table at DMA-issue time: the table rides in as a
-scalar-prefetch argument, so every grid step's BlockSpec index_map gathers
-the right physical page without materializing a dense cache.
+instantiation streams a CONTIGUOUS [B, S, ...] cache; this one streams the
+same online-softmax blocks but resolves each kv block through the page
+table at DMA-issue time: the table rides in as a scalar-prefetch argument,
+so every grid step's BlockSpec index_map gathers the right physical page
+without materializing a dense cache. The kernel BODY is literally the
+dense decode body — page indirection lives entirely in the index maps.
 
-Grid (B, Hkv, max_pages): kv axis innermost/sequential, one page per step;
-m/l/acc scratch persists across a (slot, kv-head) pair's pages. Pages past
-the slot's valid prefix are skipped (predicated off kv_len, exactly like
-the dense kernel — a young sequence pays only for the pages it has).
-Unallocated table entries point at the reserved scratch page; their blocks
-are skipped by the same predicate, so the DMA fetches a harmless page and
-the compute never runs.
+Pages past the slot's valid prefix are skipped (predicated off kv_len,
+exactly like the dense instantiation — a young sequence pays only for the
+pages it has). Unallocated table entries point at the reserved scratch
+page; their blocks are skipped by the same predicate, so the DMA fetches a
+harmless page and the compute never runs.
 
-GQA comes free the same way as the dense kernel: q is [B, Hkv, G, D] and
-the q tile is the G grouped query heads of one kv head.
-"""
+This module is the stable import point; the implementation lives in the
+template."""
 
 from __future__ import annotations
 
-import functools
-from typing import Optional
+from megatron_tpu.ops.pallas.flash_template import (  # noqa: F401
+    _NEG_INF,
+    _interpret,
+    _with_page_table,
+    paged_flash_decode,
+    paged_flash_decode_mq,
+)
 
-import jax
-import jax.numpy as jnp
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
-
-_NEG_INF = float(-1e30)
-
-
-def _interpret() -> bool:
-    # interpreter mode on CPU hosts (tests/CI), hardware kernel on TPU
-    return jax.default_backend() == "cpu"
-
-
-def _paged_decode_kernel(lens_ref, pt_ref, q_ref, k_ref, v_ref, o_ref,
-                         m_scr, l_scr, acc_scr,
-                         *, scale: float, window: Optional[int],
-                         page_size: int, groups: int):
-    b = pl.program_id(0)
-    ki = pl.program_id(2)
-    nk = pl.num_programs(2)
-    kv_len = lens_ref[b]
-
-    @pl.when(ki == 0)
-    def _init():
-        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
-        l_scr[:] = jnp.zeros_like(l_scr)
-        acc_scr[:] = jnp.zeros_like(acc_scr)
-
-    # only pages inside the slot's valid prefix compute; later pages (and
-    # scratch-mapped unallocated entries) are dead weight the predicate
-    # skips
-    @pl.when(ki * page_size < kv_len)
-    def _compute():
-        q = q_ref[0, 0].astype(jnp.float32) * scale      # [G, D]
-        k = k_ref[0, 0].astype(jnp.float32)              # [ps, D]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [G, ps]
-
-        k_pos = ki * page_size + jax.lax.broadcasted_iota(
-            jnp.int32, (groups, page_size), 1)
-        allowed = k_pos < kv_len
-        if window is not None:
-            # Mistral semantics: the newest position (kv_len - 1) sees at
-            # most the last `window` positions
-            allowed &= k_pos >= kv_len - window
-        s = jnp.where(allowed, s, _NEG_INF)
-
-        m_prev = m_scr[:]                                # [G, 1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.where(allowed, jnp.exp(s - m_new), 0.0)
-        alpha = jnp.exp(m_prev - m_new)
-        v = v_ref[0, 0].astype(jnp.float32)              # [ps, D]
-        pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())))
-        acc_scr[:] = acc_scr[:] * alpha + pv
-        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
-        m_scr[:] = m_new
-
-    @pl.when(ki == nk - 1)
-    def _emit():
-        l = jnp.maximum(l_scr[:], 1e-30)
-        o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
-
-
-def _paged_mq_decode_kernel(lens_ref, pt_ref, q_ref, k_ref, v_ref, o_ref,
-                            m_scr, l_scr, acc_scr,
-                            *, scale: float, window: Optional[int],
-                            page_size: int, groups: int, sq: int):
-    """Multi-query variant: the q tile is the Sq speculative query rows
-    x G grouped heads of one kv head, flattened to [Sq*G, D]; query j
-    sees k_pos < kv_lengths + j (each verify query one position deeper).
-    Page resolution is identical to the single-query kernel — queries
-    never index pages, only the kv blocks do."""
-    b = pl.program_id(0)
-    ki = pl.program_id(2)
-    nk = pl.num_programs(2)
-    kv_len = lens_ref[b]
-    R = sq * groups
-
-    @pl.when(ki == 0)
-    def _init():
-        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
-        l_scr[:] = jnp.zeros_like(l_scr)
-        acc_scr[:] = jnp.zeros_like(acc_scr)
-
-    # the deepest query (sq - 1) sees up to kv_len + sq - 2
-    @pl.when(ki * page_size < kv_len + sq - 1)
-    def _compute():
-        q = q_ref[0, 0].astype(jnp.float32) * scale      # [R, D]
-        k = k_ref[0, 0].astype(jnp.float32)              # [ps, D]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [R, ps]
-
-        k_pos = ki * page_size + jax.lax.broadcasted_iota(
-            jnp.int32, (R, page_size), 1)
-        q_idx = jax.lax.broadcasted_iota(jnp.int32, (R, page_size), 0) // groups
-        allowed = k_pos < kv_len + q_idx
-        if window is not None:
-            allowed &= k_pos >= kv_len + q_idx - window
-        s = jnp.where(allowed, s, _NEG_INF)
-
-        m_prev = m_scr[:]                                # [R, 1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.where(allowed, jnp.exp(s - m_new), 0.0)
-        alpha = jnp.exp(m_prev - m_new)
-        v = v_ref[0, 0].astype(jnp.float32)              # [ps, D]
-        pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())))
-        acc_scr[:] = acc_scr[:] * alpha + pv
-        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
-        m_scr[:] = m_new
-
-    @pl.when(ki == nk - 1)
-    def _emit():
-        l = jnp.maximum(l_scr[:], 1e-30)
-        o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
-
-
-def paged_flash_decode_mq(
-    q: jnp.ndarray,            # [B, Sq, Hq, D] (Sq = spec k+1 query rows)
-    k_pages: jnp.ndarray,      # [P, ps, Hkv, D] shared page pool
-    v_pages: jnp.ndarray,      # [P, ps, Hkv, D]
-    page_table: jnp.ndarray,   # [B, max_pages] int32
-    kv_lengths: jnp.ndarray,   # [B] int32, FIRST query's visible prefix
-    sliding_window: Optional[int] = None,
-) -> jnp.ndarray:
-    """Multi-query decode attention over paged KV (the speculative
-    verify pass: query j sees k_pos < kv_lengths + j). Returns
-    [B, Sq, Hq, D]; ValueError for unsupported shapes (the attention()
-    dispatcher falls back to the gather + masked einsum)."""
-    b, sq, hq, d = q.shape
-    _, ps, hkv, _ = k_pages.shape
-    if hq % hkv:
-        raise ValueError(f"query heads {hq} not a multiple of kv heads {hkv}")
-    if ps % 8:
-        raise ValueError(f"page_size {ps} must be a multiple of 8")
-    if page_table.shape[0] != b:
-        raise ValueError(
-            f"page_table rows {page_table.shape[0]} != batch {b}")
-    groups = hq // hkv
-    R = sq * groups
-    max_pages = page_table.shape[1]
-
-    qt = q.reshape(b, sq, hkv, groups, d).transpose(0, 2, 1, 3, 4)
-    qt = qt.reshape(b, hkv, R, d)                        # [B, Hkv, R, D]
-    kt = jnp.transpose(k_pages, (0, 2, 1, 3))            # [P, Hkv, ps, D]
-    vt = jnp.transpose(v_pages, (0, 2, 1, 3))
-    lens = jnp.asarray(kv_lengths, jnp.int32)
-    table = jnp.asarray(page_table, jnp.int32)
-
-    kernel = functools.partial(
-        _paged_mq_decode_kernel, scale=float(1.0 / (d ** 0.5)),
-        window=sliding_window, page_size=ps, groups=groups, sq=sq)
-
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(b, hkv, max_pages),
-        in_specs=[
-            pl.BlockSpec((1, 1, R, d),
-                         lambda bi, h, ki, lens, pt: (bi, h, 0, 0)),
-            pl.BlockSpec((1, 1, ps, d),
-                         lambda bi, h, ki, lens, pt: (pt[bi, ki], h, 0, 0)),
-            pl.BlockSpec((1, 1, ps, d),
-                         lambda bi, h, ki, lens, pt: (pt[bi, ki], h, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, 1, R, d),
-                               lambda bi, h, ki, lens, pt: (bi, h, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((R, 1), jnp.float32),
-            pltpu.VMEM((R, 1), jnp.float32),
-            pltpu.VMEM((R, d), jnp.float32),
-        ],
-    )
-    o = pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, hkv, R, d), q.dtype),
-        interpret=_interpret(),
-    )(lens, table, qt, kt, vt)
-    return o.reshape(b, hkv, sq, groups, d).transpose(0, 2, 1, 3, 4
-                                                      ).reshape(b, sq, hq, d)
-
-
-def paged_flash_decode(
-    q: jnp.ndarray,            # [B, 1, Hq, D]
-    k_pages: jnp.ndarray,      # [P, ps, Hkv, D] shared page pool
-    v_pages: jnp.ndarray,      # [P, ps, Hkv, D]
-    page_table: jnp.ndarray,   # [B, max_pages] int32 physical page per block
-    kv_lengths: jnp.ndarray,   # [B] int32, valid prefix per row
-    sliding_window: Optional[int] = None,
-) -> jnp.ndarray:
-    """Single-token decode attention over paged KV with per-row prefix
-    masking. Returns [B, 1, Hq, D]. Raises ValueError for unsupported
-    shapes (the attention() dispatcher falls back to the gather +
-    masked-einsum path)."""
-    b, sq, hq, d = q.shape
-    _, ps, hkv, _ = k_pages.shape
-    if sq != 1:
-        raise ValueError(
-            f"paged_flash_decode is single-token only (q_len={sq})")
-    if hq % hkv:
-        raise ValueError(f"query heads {hq} not a multiple of kv heads {hkv}")
-    if ps % 8:
-        # TPU sublane alignment for the [ps, D] kv tile; the gather
-        # fallback covers exotic page sizes
-        raise ValueError(f"page_size {ps} must be a multiple of 8")
-    if page_table.shape[0] != b:
-        raise ValueError(
-            f"page_table rows {page_table.shape[0]} != batch {b}")
-    groups = hq // hkv
-    max_pages = page_table.shape[1]
-
-    qt = q.reshape(b, 1, hkv, groups, d).squeeze(1)      # [B, Hkv, G, D]
-    kt = jnp.transpose(k_pages, (0, 2, 1, 3))            # [P, Hkv, ps, D]
-    vt = jnp.transpose(v_pages, (0, 2, 1, 3))
-    lens = jnp.asarray(kv_lengths, jnp.int32)
-    table = jnp.asarray(page_table, jnp.int32)
-
-    kernel = functools.partial(
-        _paged_decode_kernel, scale=float(1.0 / (d ** 0.5)),
-        window=sliding_window, page_size=ps, groups=groups)
-
-    # scalar-prefetch index maps: (grid indices..., lens_ref, pt_ref) ->
-    # block indices; the kv maps dereference the page table so the DMA
-    # fetches the slot's physical page for this logical block
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(b, hkv, max_pages),
-        in_specs=[
-            pl.BlockSpec((1, 1, groups, d),
-                         lambda bi, h, ki, lens, pt: (bi, h, 0, 0)),
-            pl.BlockSpec((1, 1, ps, d),
-                         lambda bi, h, ki, lens, pt: (pt[bi, ki], h, 0, 0)),
-            pl.BlockSpec((1, 1, ps, d),
-                         lambda bi, h, ki, lens, pt: (pt[bi, ki], h, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, 1, groups, d),
-                               lambda bi, h, ki, lens, pt: (bi, h, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((groups, 1), jnp.float32),
-            pltpu.VMEM((groups, 1), jnp.float32),
-            pltpu.VMEM((groups, d), jnp.float32),
-        ],
-    )
-    o = pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, hkv, groups, d), q.dtype),
-        interpret=_interpret(),
-    )(lens, table, qt, kt, vt)
-    return o.reshape(b, 1, hq, d)
+__all__ = ["paged_flash_decode", "paged_flash_decode_mq"]

@@ -192,6 +192,14 @@ class TrainLoop:
         self._profile_until: Optional[int] = None
 
         model_cfg = run_cfg.model
+        if model_cfg.attention_impl == "pallas":
+            # one line at startup so the gradient path is never a mystery
+            # in the log: flash_bwd on = the template's custom-vjp
+            # kernels, off = the XLA-generated O(S^2) attention gradient
+            self.log("attention: pallas flash template, "
+                     + ("fused fwd+bwd (custom vjp)" if model_cfg.flash_bwd
+                        else "fwd only — XLA O(S^2) attention gradient "
+                        "(--no_flash_bwd)"))
         E = model_cfg.num_experts
         if E is not None and E % self.rt.ep:
             raise ValueError(

@@ -316,6 +316,31 @@ def test_train_step_audit_clean():
     assert len(don.donated) > 10  # params + masters + moments + scalars
 
 
+def test_train_step_flash_bwd_audit_clean():
+    """The train step with attention routed through the flash template
+    (ISSUE 16): the GRADIENT path runs the custom-vjp pallas kernels —
+    pallas calls visibly in the step jaxpr (fwd, remat fwd, dq, dk/dv;
+    the deterministic form of bench's train_attention_bwd_speedup gate)
+    — with the same cleanliness contract as the einsum step: zero host
+    callbacks, zero unexpected promotions, full state donation."""
+    t = targets.flash_bwd_train_step_target()
+    jaxpr = t.jaxpr()
+    assert str(jaxpr).count("pallas_call") >= 3  # fwd + bwd kernels
+
+    rep = jaxpr_audit.audit_jaxpr(jaxpr, t.name)
+    assert rep.callbacks == []
+    assert rep.scalar_carries == []
+    assert rep.manual_constraints == []
+    assert rep.promotions == [], rep.promotions
+
+    don = jaxpr_audit.audit_donation(t.lowered())
+    state_undonated = [p for p, _ in don.undonated
+                       if not any(k in p for k in
+                                  ("tokens", "labels", "loss_mask"))]
+    assert state_undonated == [], state_undonated
+    assert len(don.donated) > 10
+
+
 def test_decode_step_audit_clean():
     """Engine decode step: zero collectives (single-device contract),
     zero host callbacks, the KV cache donated. The only tolerated

@@ -69,14 +69,18 @@ def test_bench_main_emits_one_json_line(monkeypatch):
         bench, "serve_compressed_comm_bench",
         functools.partial(bench.serve_compressed_comm_bench,
                           num_slots=2, new_tokens=8, reps=1))
+    monkeypatch.setattr(
+        bench, "train_attention_bwd_bench",
+        functools.partial(bench.train_attention_bwd_bench, s=128, d=32,
+                          iters=1))
     buf = io.StringIO()
     with redirect_stdout(buf):
         bench.main()
     lines = [l for l in buf.getvalue().splitlines() if l.strip()]
     # full (non-quick) runs: the serving metric lines + the preemption
-    # notice-budget line, then the headline LAST (the only positional
-    # contract the driver relies on)
-    assert len(lines) == 7
+    # notice-budget line + the flash-bwd gate line, then the headline
+    # LAST (the only positional contract the driver relies on)
+    assert len(lines) == 8
     serve = json.loads(lines[0])
     assert serve["metric"] == "serve_decode_throughput_toks_per_s"
     assert set(serve) >= {"metric", "value", "unit", "vs_baseline"}
@@ -118,6 +122,15 @@ def test_bench_main_emits_one_json_line(monkeypatch):
     assert pre["metric"] == "preempt_save_latency_ms"
     assert "error" not in pre, pre
     assert pre["value"] > 0
+    fb = json.loads(lines[6])
+    assert fb["metric"] == "train_attention_bwd_speedup"
+    assert "error" not in fb, fb
+    # the deterministic gate: the gradient jaxpr contains the template's
+    # kernels and the --no_flash_bwd escape hatch's doesn't (wall
+    # speedup is informational — CPU runs the pallas interpreter)
+    assert fb["detail"]["bwd_jaxpr_has_kernel"], fb
+    assert fb["detail"]["dense_jaxpr_kernel_free"], fb
+    assert fb["detail"]["kernel_calls_in_grad"] >= 3, fb
     out = json.loads(lines[-1])
     assert out["metric"] == "llama_train_step_mfu"
     assert set(out) >= {"metric", "value", "unit", "vs_baseline", "detail"}

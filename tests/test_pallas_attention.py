@@ -1,0 +1,285 @@
+"""The one flash kernel family (ops/pallas/flash_template.py) vs dense
+references, in interpret mode on the CPU suite.
+
+Three layers of proof:
+
+  1. masks.py predicate unit tests — every block-skip predicate proven
+     against a dense boolean reference (ANY of `visible` over the tile),
+     exhaustively over the edges: the causal frontier, the decode
+     ``kv_len + Sq - 1`` mq boundary, and the window LOWER edge (the new
+     windowed block skip).
+  2. parity matrix — each template instantiation (prefill fwd, the
+     custom-vjp bwd, decode, paged decode, both mq variants) vs the
+     dense einsum path over causal x kv_lengths x window x paged x mq;
+     bwd grads vs jax.grad of the dense reference.
+  3. dispatch gates — attention(impl="pallas") routes the gradient
+     through the template (jaxpr contains the pallas call) when
+     flash_bwd is on, and falls back LOUDLY (warning) when it can't or
+     when --no_flash_bwd asks it not to.
+
+The same kernels compile for real on TPU (bench.py headline path)."""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatron_tpu.ops.attention import attention
+from megatron_tpu.ops.pallas import masks
+
+RNG = np.random.default_rng(11)
+
+
+# ---------------------------------------------------------------------------
+# 1. mask predicates vs the dense boolean reference
+# ---------------------------------------------------------------------------
+
+
+def _dense_block_live(ki, blk, q_positions, causal, window):
+    """Reference: the tile is live iff ANY (q, k) element in it is
+    visible — computed from the element rule, no interval shortcuts."""
+    k_positions = np.arange(ki * blk, (ki + 1) * blk)
+    vis = masks.visible(q_positions[:, None], k_positions[None, :],
+                        causal=causal, window=window)
+    return bool(np.any(vis))
+
+
+@pytest.mark.parametrize("window", [None, 1, 3, 8, 64])
+@pytest.mark.parametrize("causal", [True, False])
+def test_prefill_block_live_matches_dense(causal, window):
+    blk_q, blk_k = 8, 8
+    for delta in (0, 5, 64):
+        for qi in range(6):
+            q_pos = np.arange(qi * blk_q, (qi + 1) * blk_q) + delta
+            for ki in range(8):
+                want = _dense_block_live(ki, blk_k, q_pos, causal, window)
+                got = masks.prefill_block_live(
+                    qi, ki, blk_q, blk_k, causal=causal, window=window,
+                    delta=delta)
+                assert bool(got) == want, (qi, ki, delta)
+
+
+@pytest.mark.parametrize("window", [None, 1, 4, 16])
+@pytest.mark.parametrize("sq", [1, 4])
+def test_decode_block_live_matches_dense(sq, window):
+    """Including the mq boundary: the deepest query sits at
+    kv_len + sq - 2, so the last live causal block is the one containing
+    it — checked for every kv_len around every block edge."""
+    blk = 8
+    nk = 6
+    for kv_len in range(1, blk * nk + 1):
+        q_pos = kv_len - 1 + np.arange(sq)
+        for ki in range(nk):
+            want = _dense_block_live(ki, blk, q_pos, True, window)
+            got = masks.decode_block_live(ki, blk, kv_len, sq, window=window)
+            assert bool(got) == want, (kv_len, ki)
+
+
+def test_window_lower_edge_is_tight():
+    """The windowed skip keeps exactly the tiles intersecting
+    (q_lo - W, q_hi]: the tile just below the window's lower edge is
+    dead, the one containing the edge is live."""
+    blk = 8
+    # queries at [32, 39]; W=4: the shallowest query sees (28, 32], so
+    # tile 3 (cols 24..31) is live only through its top columns 29..31
+    assert masks.block_live(3, blk, 32, 39, window=4)
+    assert not masks.block_live(2, blk, 32, 39, window=4)   # cols 16..23
+    # W=1: the band is (31, 39] — tile 3's last column (31) is exactly
+    # NOT in it, tile 4 is
+    assert not masks.block_live(3, blk, 32, 39, window=1)
+    assert masks.block_live(3, blk, 32, 39, window=2)       # 31 > 30
+    assert masks.block_live(4, blk, 32, 39, window=1)
+    assert not masks.block_live(5, blk, 32, 39, window=None)  # causal edge
+    assert masks.block_live(5, blk, 32, 47, window=None)
+
+
+def test_decode_positions_are_the_causal_rule():
+    """The historical decode mask k_pos < kv_len + q_idx IS `visible`
+    at q_pos = kv_len - 1 + q_idx — the unification the template rests
+    on."""
+    kv_len, groups, sq, blk = 13, 2, 3, 8
+    rows = sq * groups
+    q_pos, k_pos = masks.decode_positions(1, blk, kv_len, groups, rows)
+    got = masks.visible(q_pos, k_pos, causal=True)
+    q_idx = np.arange(rows)[:, None] // groups
+    legacy = (np.arange(blk)[None, :] + blk) < kv_len + q_idx
+    np.testing.assert_array_equal(np.asarray(got), legacy)
+
+
+# ---------------------------------------------------------------------------
+# 2. parity matrix (interpret mode)
+# ---------------------------------------------------------------------------
+
+
+def _qkv(b=1, s=128, hq=4, hkv=2, d=32, skv=None):
+    skv = s if skv is None else skv
+    q = jnp.asarray(RNG.standard_normal((b, s, hq, d)), jnp.float32)
+    k = jnp.asarray(RNG.standard_normal((b, skv, hkv, d)), jnp.float32)
+    v = jnp.asarray(RNG.standard_normal((b, skv, hkv, d)), jnp.float32)
+    return q, k, v
+
+
+@pytest.mark.parametrize("window", [None, 48])
+@pytest.mark.parametrize("causal", [True, False])
+def test_template_forward_parity(causal, window):
+    from megatron_tpu.ops.pallas.flash_template import flash_mha
+
+    q, k, v = _qkv()
+    got = flash_mha(q, k, v, sliding_window=window, causal=causal,
+                    block_q=64, block_k=64)
+    want = attention(q, k, v, sliding_window=window,
+                     mask_type="causal" if causal else "bidirectional")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("window", [None, 48])
+@pytest.mark.parametrize("hq,hkv", [(2, 2), (4, 2)])
+def test_template_bwd_grads_vs_dense_jax_grad(hq, hkv, window):
+    """The recompute backward (dq + dk/dv kernels behind custom_vjp) vs
+    jax.grad of the dense einsum, causal x window x GQA."""
+    from megatron_tpu.ops.pallas.flash_template import flash_mha
+
+    q, k, v = _qkv(hq=hq, hkv=hkv)
+
+    def f_flash(q, k, v):
+        return jnp.sum(jnp.square(flash_mha(q, k, v, sliding_window=window,
+                                            block_q=64, block_k=64)))
+
+    def f_ref(q, k, v):
+        return jnp.sum(jnp.square(attention(q, k, v, sliding_window=window)))
+
+    gf = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gf, gr):
+        scale = float(jnp.max(jnp.abs(b)))
+        np.testing.assert_allclose(np.asarray(a) / scale,
+                                   np.asarray(b) / scale,
+                                   rtol=2e-2, atol=2e-3, err_msg=f"d{name}")
+
+
+@pytest.mark.parametrize("window", [None, 40])
+@pytest.mark.parametrize("sq", [1, 3])
+def test_decode_window_parity(sq, window):
+    """Decode instantiations (sq=1 plain, sq>1 speculative mq) with the
+    sliding-window knob vs the masked einsum."""
+    from megatron_tpu.ops.pallas.flash_decode import (flash_decode,
+                                                      flash_decode_mq)
+
+    q, k, v = _qkv(b=3, s=sq, skv=256, hq=4, hkv=2, d=32)
+    lens = jnp.asarray([1, 100, 256 - sq + 1], jnp.int32)
+    fn = flash_decode if sq == 1 else flash_decode_mq
+    got = fn(q, k, v, lens, sliding_window=window, block_k=128)
+    want = attention(q, k, v, kv_lengths=lens, sliding_window=window,
+                     impl="xla")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-3, atol=2e-3)
+
+
+def _paged(k, v, ps):
+    """Chop a dense [B, S, hkv, d] cache into a shared page pool with
+    page 0 reserved as scratch; returns (k_pages, v_pages, table)."""
+    b, s, hkv, d = k.shape
+    npages = s // ps
+    kp = [jnp.zeros((ps, hkv, d), k.dtype)]
+    vp = [jnp.zeros((ps, hkv, d), v.dtype)]
+    table = np.zeros((b, npages), np.int32)
+    for bi in range(b):
+        for p in range(npages):
+            table[bi, p] = len(kp)
+            kp.append(k[bi, p * ps:(p + 1) * ps])
+            vp.append(v[bi, p * ps:(p + 1) * ps])
+    return jnp.stack(kp), jnp.stack(vp), jnp.asarray(table)
+
+
+@pytest.mark.parametrize("window", [None, 40])
+@pytest.mark.parametrize("sq", [1, 3])
+def test_paged_decode_window_parity(sq, window):
+    """The paged knob: same body, page-table index maps — vs the dense
+    gather reference, including sliding window."""
+    from megatron_tpu.ops.pallas.paged_flash_decode import (
+        paged_flash_decode, paged_flash_decode_mq)
+
+    ps = 64
+    q, k, v = _qkv(b=3, s=sq, skv=256, hq=4, hkv=2, d=32)
+    kp, vp, table = _paged(k, v, ps)
+    lens = jnp.asarray([1, 100, 256 - sq + 1], jnp.int32)
+    fn = paged_flash_decode if sq == 1 else paged_flash_decode_mq
+    got = fn(q, kp, vp, table, lens, sliding_window=window)
+    want = attention(q, k, v, kv_lengths=lens, sliding_window=window,
+                     impl="xla")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-3, atol=2e-3)
+
+
+# ---------------------------------------------------------------------------
+# 3. dispatch gates
+# ---------------------------------------------------------------------------
+
+
+def test_dispatch_uses_template_bwd_when_forced(monkeypatch):
+    """With interpret forced, attention(impl='pallas') routes through the
+    template and the GRADIENT jaxpr contains the pallas kernels — the
+    deterministic form of the bench gate (no XLA-generated O(S^2)
+    attention gradient)."""
+    monkeypatch.setenv("MEGATRON_TPU_FLASH_INTERPRET", "1")
+    q, k, v = _qkv()
+
+    def loss(q, k, v):
+        return jnp.sum(attention(q, k, v, impl="pallas"))
+
+    jaxpr = str(jax.make_jaxpr(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v))
+    assert "pallas_call" in jaxpr
+    out = attention(q, k, v, impl="pallas")
+    want = attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_dispatch_no_flash_bwd_is_loud_and_dense(monkeypatch):
+    """--no_flash_bwd: same numbers, NO pallas call in the jaxpr, and a
+    warning so the dense gradient can't sneak in silently."""
+    monkeypatch.setenv("MEGATRON_TPU_FLASH_INTERPRET", "1")
+    q, k, v = _qkv()
+    with pytest.warns(UserWarning, match="flash_bwd disabled"):
+        out = attention(q, k, v, impl="pallas", flash_bwd=False)
+    want = attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-3, atol=2e-3)
+
+    def loss(q, k, v):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            return jnp.sum(attention(q, k, v, impl="pallas",
+                                     flash_bwd=False))
+
+    jaxpr = str(jax.make_jaxpr(jax.grad(loss))(q, k, v))
+    assert "pallas_call" not in jaxpr
+
+
+def test_dispatch_geometry_fallback_is_loud(monkeypatch):
+    """A geometry the template can't instantiate (seq longer than the
+    default block but not divisible by it) falls back to XLA with a
+    warning naming the gradient."""
+    monkeypatch.setenv("MEGATRON_TPU_FLASH_INTERPRET", "1")
+    q, k, v = _qkv(s=300, hq=2, hkv=1, d=16)
+    with pytest.warns(UserWarning, match="O\\(S\\^2\\)"):
+        out = attention(q, k, v, impl="pallas")
+    want = attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_dispatch_stays_dense_on_cpu_without_forcing(monkeypatch):
+    """CPU sanity runs must not pay the pallas interpreter: without the
+    env var, impl='pallas' runs the fused XLA path."""
+    monkeypatch.delenv("MEGATRON_TPU_FLASH_INTERPRET", raising=False)
+    q, k, v = _qkv()
+
+    def loss(q, k, v):
+        return jnp.sum(attention(q, k, v, impl="pallas"))
+
+    jaxpr = str(jax.make_jaxpr(loss)(q, k, v))
+    assert "pallas_call" not in jaxpr
