@@ -27,12 +27,13 @@ bit-for-bit.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import sys
 import threading
 import time
 from collections import deque
-from typing import Any, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -165,6 +166,14 @@ class InferenceEngine:
                 f"max_seq_len {self.max_seq_len} exceeds "
                 f"max_position_embeddings {cfg.max_position_embeddings}")
         self.kv_cache_int8 = kv_cache_int8
+        # migration wire codec for FLOAT caches (fleet/migration.py):
+        # "raw" ships native bytes (exact); "int8"/"fp8" quantize via
+        # quant/primitives.py (smaller, NOT bit-exact — importers that
+        # require token identity recompute-resume instead). int8 caches
+        # always ship their own quantized pages + scales verbatim
+        # ("int8-native", exact). Operators set this attribute directly.
+        self.kv_wire = "raw"
+        self.kv_wire_chunk = 32
         self.prefill_bucket = prefill_bucket
         self.vocab_size = vocab_size
         self.mesh = mesh
@@ -246,6 +255,15 @@ class InferenceEngine:
         # admissions popped from the queue but not yet landed in a slot —
         # wait_idle() must not report idle while one is mid-prefill
         self._admitting = 0
+        # state-migration pause (paused()): while _pause_count > 0 the
+        # step loop parks BETWEEN ticks and raises _paused_evt, so an
+        # exporter/importer can touch slot state without racing a tick
+        self._pause_count = 0
+        self._paused_evt = threading.Event()
+        # once-jitted KV install writer (migration import) — separate jit
+        # from the decode step, so imports cost zero decode recompiles
+        self._kv_writer = None
+        self._preempt_signalled = False  # preempt_replica fires once
         # last time the engine demonstrably made progress (an admission
         # or decode tick COMPLETED) — readiness uses stalled() to catch a
         # wedged step loop, the failure liveness can't see (the thread is
@@ -263,7 +281,8 @@ class InferenceEngine:
         # runtime counter instead of a bench footnote
         self.stats = {"admitted": 0, "retired": 0, "ticks": 0,
                       "rejected": 0, "decode_recompiles": 0,
-                      "timeouts": 0, "weight_reloads": 0}
+                      "timeouts": 0, "weight_reloads": 0,
+                      "kv_exports": 0, "kv_imports": 0}
         if self.spec is not None:
             # spec_emitted counts every token the spec path emitted
             # (accepted drafts + the guaranteed token per row per tick);
@@ -319,6 +338,13 @@ class InferenceEngine:
         self._m_spec_accepted = m.counter(
             "engine_spec_accepted_total",
             "draft tokens accepted by the exact accept/reject")
+        self._m_kv_exports = m.counter(
+            "engine_kv_exports_total",
+            "request states exported for migration")
+        self._m_kv_imports = m.counter(
+            "engine_kv_imports_total",
+            "migrated request states imported, by resume path",
+            label_names=("path",))
         self._m_spec_len = m.histogram(
             "engine_spec_accept_length",
             "accepted drafts per slot per tick (0..k)",
@@ -765,17 +791,31 @@ class InferenceEngine:
         return n
 
     def _admit_one(self, i: int, req: Request) -> int:
-        """Prefill `req` into free slot `i`; returns 1 if admitted."""
+        """Prefill `req` into free slot `i`; returns 1 if admitted.
+
+        A resumed request (a preserved PRNG chain and/or already-generated
+        tokens — recompute-resume after preemption or migration) teacher-
+        forces prompt + generated in one prefill and samples the NEXT
+        token at the final position with the preserved chain: the exact
+        token the interrupted decode tick would have sampled, greedy or
+        not (the paged engine's _try_assign is the same contract)."""
         self._sync_carry()
-        p = len(req.prompt)
+        resumed = req.resume_key is not None or bool(req.generated)
+        full = (np.concatenate([np.asarray(req.prompt, np.int32),
+                                np.asarray(req.generated, np.int32)])
+                if resumed else np.asarray(req.prompt, np.int32))
+        p = len(full)
         P = self._bucket(p)
         toks = np.zeros((1, P), np.int32)
-        toks[0, :p] = req.prompt
+        toks[0, :p] = full
+        key0 = (jnp.asarray(np.asarray(req.resume_key, np.uint32))
+                if req.resume_key is not None
+                else jax.random.PRNGKey(req.seed))
         t_prefill = time.monotonic()
         try:
             tok, lp, plp, caches, key = self._prefill_step(P)(
                 self.params, self.caches, jnp.asarray(toks),
-                jnp.int32(p), jnp.int32(i), jax.random.PRNGKey(req.seed),
+                jnp.int32(p), jnp.int32(i), key0,
                 jnp.float32(req.temperature), jnp.int32(req.top_k),
                 jnp.float32(req.top_p))
             self.caches = caches
@@ -816,14 +856,17 @@ class InferenceEngine:
             self._spec_rows_dev = None
         req.generated.append(int(tok))
         req.logprobs.append(float(lp))
-        req.prompt_logprobs = [float(x) for x in plp[:p - 1]]
+        if not resumed:
+            req.prompt_logprobs = [float(x) for x in plp[:p - 1]]
         self.stats["admitted"] += 1
         self._count_comm_prefill(P)
         now = time.monotonic()
-        req.first_token_time = now
         self._m_prefill.observe(now - t_prefill)
-        if req.submit_time is not None:
-            self._m_ttft.observe(now - req.submit_time)
+        if not resumed:
+            # TTFT is first-admission only: a resume's clock restarted
+            req.first_token_time = now
+            if req.submit_time is not None:
+                self._m_ttft.observe(now - req.submit_time)
         self._m_admitted.inc()
         self._m_tokens.inc()
         self._m_active.set(self.num_active)
@@ -855,6 +898,12 @@ class InferenceEngine:
         swaps, and deadline expiry."""
         tick = self.stats["ticks"]
         resilience.maybe_kill("kill_replica", tick)
+        if (not self._preempt_signalled
+                and resilience.fault_active("preempt_replica", tick)):
+            # once per process: ticks only advance on decode, and a
+            # second SIGTERM would hit the server's immediate-exit path
+            self._preempt_signalled = True
+            resilience.maybe_signal("preempt_replica", tick)
         resilience.maybe_hang("hang_replica", tick)
         resilience.maybe_sleep("slow_tick", journal_once=True)
         self._apply_pending_params()
@@ -1294,6 +1343,373 @@ class InferenceEngine:
                 self._m_recompiles.inc(grew)
             self._decode_cache_seen = size
 
+    # ----- state migration (fleet/migration.py wire format) ----------------
+
+    @contextlib.contextmanager
+    def paused(self, timeout: float = 60.0):
+        """Park the step loop BETWEEN ticks so the caller may touch slot
+        state (request export/import). Counting, so nested pauses
+        compose; a no-op when no loop thread is running (tests and batch
+        drivers call step() themselves). Raises if the loop does not
+        reach a tick boundary within `timeout` — a wedged device step,
+        which the caller must not race."""
+        with self._cv:
+            self._pause_count += 1
+            self._cv.notify_all()
+        try:
+            t = self._thread
+            if (t is not None and t.is_alive()
+                    and threading.current_thread() is not t):
+                if not self._paused_evt.wait(timeout):
+                    raise RuntimeError(
+                        f"engine step loop did not pause within {timeout}s "
+                        "(wedged device step?)")
+            yield
+        finally:
+            with self._cv:
+                self._pause_count -= 1
+                if self._pause_count == 0:
+                    self._paused_evt.clear()
+                self._cv.notify_all()
+
+    def _kv_geometry(self) -> dict:
+        """The cache facts an importer must match (or fall back on)."""
+        cfg = self.cfg
+        return {
+            "layers": int(cfg.num_layers),
+            "kv_heads": int(cfg.n_kv_heads),
+            "head_dim": int(cfg.head_dim),
+            "dtype": jnp.empty((0,), cfg.dtype).dtype.name,
+            "int8": bool(self.kv_cache_int8),
+            "sliding_window": (None if cfg.sliding_window_size is None
+                               else int(cfg.sliding_window_size)),
+        }
+
+    def _pack_kv_sections(self, leaves: List[np.ndarray], length: int
+                          ) -> Tuple[dict, Dict[str, np.ndarray]]:
+        """Encode canonical-layout KV leaves (each [L, T, H, D] host
+        arrays, T = committed positions) into wire sections + a codec
+        descriptor. Three codecs:
+
+          int8-native  the int8 cache's own quantized pages + per-position
+                       scales ride verbatim — exact w.r.t. what the source
+                       would have decoded from (ops/kv_quant.py recipe)
+          raw          float caches ship native bytes — exact
+          int8 / fp8   opt-in lossy chunked wire (quant/primitives.py,
+                       self.kv_wire) — ~2-4x fewer bytes, exact=False, so
+                       a token-identity importer recompute-resumes
+        """
+        from megatron_tpu.quant import primitives as qp
+
+        geo = self._kv_geometry()
+        sections: Dict[str, np.ndarray] = {}
+        if self.kv_cache_int8:
+            k_q, v_q, k_s, v_s = leaves
+            sections.update(kv_k=k_q, kv_v=v_q,
+                            kv_k_scale=k_s, kv_v_scale=v_s)
+            codec, exact = "int8-native", True
+        elif self.kv_wire in ("int8", "fp8"):
+            mode = self.kv_wire
+            if mode == "fp8" and not qp.fp8_supported():
+                mode = "int8"  # same gate as compressed collectives
+            chunk = qp.effective_chunk(geo["head_dim"], self.kv_wire_chunk)
+            for name, leaf in zip(("kv_k", "kv_v"), leaves):
+                q, s = qp.quantize_chunked(jnp.asarray(leaf), chunk, mode)
+                sections[name] = np.asarray(q)
+                sections[name + "_scale"] = np.asarray(s)
+            geo["wire_chunk"] = int(chunk)
+            codec, exact = mode, False
+        else:
+            sections.update(kv_k=np.asarray(leaves[0]),
+                            kv_v=np.asarray(leaves[1]))
+            codec, exact = "raw", True
+        meta = dict(geo, codec=codec, exact=exact, length=int(length))
+        return meta, sections
+
+    def _decode_kv_sections(self, kv: dict, sections: Dict[str, np.ndarray]
+                            ) -> List[np.ndarray]:
+        """Wire sections -> canonical host leaves matching THIS engine's
+        cache tuple arity (inverse of _pack_kv_sections)."""
+        codec = kv["codec"]
+        if codec == "int8-native":
+            return [sections[n] for n in
+                    ("kv_k", "kv_v", "kv_k_scale", "kv_v_scale")]
+        if codec == "raw":
+            return [sections["kv_k"], sections["kv_v"]]
+        from megatron_tpu.quant import primitives as qp
+
+        dt = jnp.empty((0,), self.cfg.dtype).dtype
+        return [np.asarray(qp.dequantize_chunked(
+                    jnp.asarray(sections[n]),
+                    jnp.asarray(sections[n + "_scale"]), dt))
+                for n in ("kv_k", "kv_v")]
+
+    def _export_slot_kv(self, i: int
+                        ) -> Optional[Tuple[dict, Dict[str, np.ndarray]]]:
+        """Host snapshot of slot i's committed KV (positions 0..length-1)
+        in the canonical geometry-independent [L, T, H, D] layout, or
+        None when no exact export exists (the importer recompute-resumes
+        instead). The paged engine overrides this with a page gather."""
+        length = int(self.lengths[i])
+        if length <= 0:
+            return None
+        host = [np.asarray(leaf)[:, i, :length]
+                for leaf in jax.device_get(self.caches)]
+        return self._pack_kv_sections(host, length)
+
+    def export_request_state(self, req: Request, include_kv: bool = True
+                             ) -> Tuple[dict, Dict[str, np.ndarray]]:
+        """Snapshot one request's FULL resumable state: tokens (prompt +
+        generated), sampling knobs, seed, remaining deadline, PRNG chain
+        + absolute position, and (for a decoding slot) its KV pages.
+        Token-identity contract: an importer resuming from this snapshot
+        emits exactly the tokens this engine would have — greedy AND
+        sampled, because the chain keys migrate. Call with the step loop
+        paused (self.paused()) or from the driver thread."""
+        meta: Dict[str, Any] = {
+            "kind": "request",
+            "prompt": [int(t) for t in np.asarray(req.prompt).tolist()],
+            "generated": [int(t) for t in req.generated],
+            "logprobs": [float(x) for x in req.logprobs],
+            "prompt_logprobs": [float(x) for x in req.prompt_logprobs],
+            "max_new_tokens": int(req.max_new_tokens),
+            "temperature": float(req.temperature),
+            "top_k": int(req.top_k),
+            "top_p": float(req.top_p),
+            "eod": None if req.eod is None else int(req.eod),
+            "seed": int(req.seed),
+            "spec": bool(req.spec),
+        }
+        if req._deadline is not None:
+            meta["deadline_remaining_s"] = round(
+                max(req._deadline - time.monotonic(), 0.001), 6)
+        sections: Dict[str, np.ndarray] = {}
+        slot = next((i for i, s in enumerate(self.slots) if s is req), None)
+        mid_prefill = (slot is not None and hasattr(self, "prefill_queue")
+                       and slot in self.prefill_queue.slots)
+        if slot is not None and not mid_prefill:
+            self._sync_carry()
+            sections["resume_key"] = np.asarray(self.keys[slot],
+                                                np.uint32).copy()
+            meta["position"] = int(self.lengths[slot])
+            if include_kv:
+                kv = self._export_slot_kv(slot)
+                if kv is not None:
+                    meta["kv"], kv_sections = kv[0], kv[1]
+                    sections.update(kv_sections)
+        elif req.resume_key is not None:
+            # queued-but-previously-preempted: the chain survives even
+            # though no slot state does (chunked prefills never consume
+            # PRNG before the final chunk, so this resume stays exact)
+            sections["resume_key"] = np.asarray(req.resume_key,
+                                                np.uint32).copy()
+        self.stats["kv_exports"] += 1
+        self._m_kv_exports.inc()
+        return meta, sections
+
+    def export_all_requests(self, include_kv: bool = True
+                            ) -> List[Tuple[Request, dict,
+                                            Dict[str, np.ndarray]]]:
+        """Atomically REMOVE every queued and active request and return
+        [(live request, meta, sections), ...]. The engine is empty
+        afterwards (a drain completes immediately); the caller owns
+        completing or failing each returned Request — their waiters are
+        still blocked on req.done."""
+        out: List[Tuple[Request, dict, Dict[str, np.ndarray]]] = []
+        with self.paused():
+            self._sync_carry()
+            for i in range(self.num_slots):
+                req = self.slots[i]
+                if req is None or req.done.is_set():
+                    continue
+                meta, sections = self.export_request_state(
+                    req, include_kv=include_kv)
+                self._clear_slot(i)
+                out.append((req, meta, sections))
+            self._sync_carry()
+            self._m_active.set(self.num_active)
+            with self._cv:
+                queued = list(self._queue)
+                self._queue.clear()
+                self._m_queue.set(0)
+            for req in queued:
+                if req.done.is_set():
+                    continue
+                meta, sections = self.export_request_state(
+                    req, include_kv=False)
+                out.append((req, meta, sections))
+        return out
+
+    def _kv_import_compatible(self, kv: dict) -> Tuple[bool, str]:
+        """Whether a transferred KV state can be installed DIRECTLY into
+        this engine's cache (vs recompute-resume). (ok, reason)."""
+        if self.mesh is not None:
+            return False, "direct KV install on mesh engines is not wired"
+        if self._has_draft_model():
+            return False, "draft-model cache migration is not wired"
+        geo = self._kv_geometry()
+        for k in ("layers", "kv_heads", "head_dim"):
+            if int(kv.get(k, -1)) != geo[k]:
+                return False, f"geometry mismatch on {k}"
+        codec = kv.get("codec")
+        if codec == "int8-native":
+            if not self.kv_cache_int8:
+                return False, "int8-native transfer into a float cache"
+        elif codec == "raw":
+            if self.kv_cache_int8:
+                return False, "raw transfer into an int8 cache"
+            if kv.get("dtype") != geo["dtype"]:
+                return False, "cache dtype mismatch"
+        elif codec in ("int8", "fp8"):
+            if self.kv_cache_int8:
+                return False, "lossy wire into an int8 cache"
+        else:
+            return False, f"unknown codec {codec!r}"
+        if int(kv["length"]) + self._capacity_margin() >= self.max_seq_len:
+            return False, "migrated context exceeds this engine's capacity"
+        return True, ""
+
+    def _free_slot_for_import(self) -> Optional[int]:
+        for i in range(self.num_slots):
+            if self.slots[i] is None:
+                return i
+        return None
+
+    def _kv_install_writer(self):
+        """Once-jitted axis-1 paste: a [L, T, ...] block into the
+        [L, N, T, ...] cache tree at a TRACED index (slot for the dense
+        engine, page for the paged pool). Static shapes, its own jit —
+        repeated imports never grow the decode step's cache (the
+        zero-decode-recompiles invariant holds through migration)."""
+        if self._kv_writer is None:
+            from functools import partial
+
+            @partial(jax.jit, donate_argnums=(0,) if self._donate() else ())
+            def write(caches, blocks, at):
+                def paste(big, sm):
+                    idx = (0, at) + (0,) * (big.ndim - 2)
+                    return jax.lax.dynamic_update_slice(
+                        big, sm[:, None].astype(big.dtype), idx)
+
+                return jax.tree.map(paste, caches, blocks)
+
+            self._kv_writer = write
+        return self._kv_writer
+
+    def _install_request_kv(self, req: Request, kv: dict,
+                            sections: Dict[str, np.ndarray]) -> bool:
+        """Write the transferred KV into a free slot's cache rows (dense
+        layout; the paged engine overrides with page allocation). False =
+        no capacity, caller falls back to recompute-resume."""
+        i = self._free_slot_for_import()
+        if i is None:
+            return False
+        length = int(kv["length"])
+        leaves = self._decode_kv_sections(kv, sections)
+        blocks = []
+        for leaf in leaves:
+            row = np.zeros((leaf.shape[0], self.max_seq_len)
+                           + leaf.shape[2:], leaf.dtype)
+            row[:, :length] = leaf
+            blocks.append(jnp.asarray(row))
+        self._sync_carry()
+        self.caches = self._kv_install_writer()(
+            self.caches, tuple(blocks), jnp.int32(i))
+        self._arm_imported_slot(i, req, length)
+        return True
+
+    def _arm_imported_slot(self, i: int, req: Request, length: int) -> None:
+        """Slot bookkeeping shared by the dense and paged installs: the
+        migrated request continues decoding at its absolute position with
+        its migrated PRNG chain — no prefill, no re-sample."""
+        req.submit_time = time.monotonic()
+        if req.deadline_s is not None:
+            req._deadline = req.submit_time + req.deadline_s
+        self.slots[i] = req
+        self.lengths[i] = length
+        self.last_tok[i] = int(req.generated[-1])
+        self.temps[i] = req.temperature
+        self.top_ks[i] = req.top_k
+        self.top_ps[i] = req.top_p
+        self.keys[i] = np.asarray(req.resume_key, np.uint32)
+        if self.spec is not None:
+            self.spec_on[i] = bool(req.spec)
+            self._spec_rows_dev = None
+        self.stats["admitted"] += 1
+        self._m_admitted.inc()
+        self._m_active.set(self.num_active)
+        self.last_progress_time = time.monotonic()
+        with self._cv:
+            self._cv.notify_all()  # wake an idle step loop
+
+    def import_request_state(self, meta: dict,
+                             sections: Dict[str, np.ndarray],
+                             allow_inexact: bool = False
+                             ) -> Tuple[Request, str]:
+        """Rebuild a migrated request in THIS engine. Returns (req, path):
+        path "kv_import" = the transferred pages were installed and decode
+        continues at the migrated position; "recompute" = the request
+        re-enters through submit() and teacher-forces prompt + generated
+        (recompute-resume — exact, just re-spends prefill FLOPs). Both
+        paths are token-identical to the uninterrupted source run unless
+        the wire codec was lossy AND allow_inexact let it through. Journals
+        a `serve_migrate` stage="import" row naming the path taken."""
+        req = Request(
+            prompt=np.asarray(meta["prompt"], np.int32),
+            max_new_tokens=int(meta["max_new_tokens"]),
+            temperature=float(meta.get("temperature", 0.0)),
+            top_k=int(meta.get("top_k", 0)),
+            top_p=float(meta.get("top_p", 0.0)),
+            eod=meta.get("eod"),
+            seed=int(meta.get("seed", 0)),
+            deadline_s=meta.get("deadline_remaining_s"),
+            spec=bool(meta.get("spec", True)))
+        req.generated = [int(t) for t in meta.get("generated", [])]
+        req.logprobs = [float(x) for x in meta.get("logprobs", [])]
+        req.prompt_logprobs = [float(x) for x in
+                               meta.get("prompt_logprobs", [])]
+        if req.generated and len(req.generated) >= req.max_new_tokens:
+            raise ValueError("migrated request is already complete")
+        if "resume_key" in sections:
+            req.resume_key = np.asarray(sections["resume_key"], np.uint32)
+        kv = meta.get("kv")
+        path, reason = "recompute", ""
+        if kv is None:
+            reason = "no KV in transfer"
+        elif not req.generated or req.resume_key is None:
+            reason = "no decode state rode along"
+        elif int(kv["length"]) != len(req.prompt) + len(req.generated) - 1:
+            reason = "inconsistent migrated position"
+        elif not (kv.get("exact") or allow_inexact):
+            reason = f"lossy wire codec {kv.get('codec')}"
+        else:
+            ok, reason = self._kv_import_compatible(kv)
+            if ok:
+                with self.paused():
+                    if self._install_request_kv(req, kv, sections):
+                        path = "kv_import"
+                    else:
+                        reason = "no free slot/pages for a direct install"
+        if path == "recompute":
+            # recompute-resume: the preempt-and-resume exactness
+            # machinery (resume_key + generated teacher-forcing) is the
+            # universal fallback — it only needs tokens and the chain
+            self.submit(req)
+        self.stats["kv_imports"] += 1
+        self._m_kv_imports.inc(path=path)
+        j = _journal.get_global_journal()
+        if j is not None:
+            fields = {"stage": "import", "path": path,
+                      "prompt_len": len(req.prompt),
+                      "generated": len(req.generated)}
+            if kv is not None:
+                fields["codec"] = kv.get("codec")
+                fields["exact"] = bool(kv.get("exact"))
+            if reason:
+                fields["fallback_reason"] = reason
+            j.emit("serve_migrate", **fields)
+        return req, path
+
     # ----- driving ---------------------------------------------------------
 
     def _mesh_scope(self):
@@ -1396,9 +1812,19 @@ class InferenceEngine:
             with self._mesh_scope():
                 while True:
                     with self._cv:
-                        while (not self._stop and self.num_active == 0
-                               and not self._queue
-                               and self._pending_params is None):
+                        while (not self._stop
+                               and (self._pause_count > 0
+                                    or (self.num_active == 0
+                                        and not self._queue
+                                        and self._pending_params is None))):
+                            if self._pause_count > 0:
+                                # state-migration pause: park between
+                                # ticks and tell the pauser slot state is
+                                # safe to touch (bounded wait — resume
+                                # notifies, the timeout is a backstop)
+                                self._paused_evt.set()
+                                self._cv.wait(timeout=0.5)
+                                continue
                             if self.flight_recorder is not None:
                                 # an IDLE engine is healthy, not hung: keep
                                 # beating (bounded wait) or the watchdog
@@ -1408,6 +1834,7 @@ class InferenceEngine:
                                 self._cv.wait(timeout=1.0)
                             else:
                                 self._cv.wait()
+                        self._paused_evt.clear()
                         if self._stop:
                             return
                     try:

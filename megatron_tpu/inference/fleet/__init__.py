@@ -14,6 +14,10 @@ from machinery earlier PRs built:
   * replica.py  — subprocess replica handle + the `python -m
                   megatron_tpu.inference.fleet.replica` entry point the
                   chaos tests SIGKILL.
+  * migration.py— KV-state migration wire format (manifest + per-section
+                  crc commit contract, torn transfers rejected loudly),
+                  the HTTP client half of request/prefix handoff, and
+                  the fleet-level PrefixDirectory.
   * reload.py   — manifest-verified committed-checkpoint param loads
                   (PR 2's verify_checkpoint machinery) feeding
                   InferenceEngine.update_params hot swaps.
@@ -28,11 +32,15 @@ Everything here is pure host code — zero new collectives (the golden comm
 manifests are unchanged; tools/comm_report.py --check).
 """
 
+from megatron_tpu.inference.fleet.migration import (  # noqa: F401
+    MigrationIntegrityError, PrefixDirectory, pack_state, replicate_prefix,
+    unpack_state,
+)
 from megatron_tpu.inference.fleet.reload import (  # noqa: F401
     load_verified_params, save_params_checkpoint,
 )
 from megatron_tpu.inference.fleet.router import (  # noqa: F401
-    ReplicaRouter, RouterServer,
+    ReplicaRouter, RouterServer, fleet_retry_after,
 )
 from megatron_tpu.inference.fleet.replica import ReplicaProcess  # noqa: F401
 
@@ -40,6 +48,12 @@ __all__ = [
     "ReplicaRouter",
     "RouterServer",
     "ReplicaProcess",
+    "MigrationIntegrityError",
+    "PrefixDirectory",
+    "fleet_retry_after",
     "load_verified_params",
+    "pack_state",
+    "replicate_prefix",
     "save_params_checkpoint",
+    "unpack_state",
 ]

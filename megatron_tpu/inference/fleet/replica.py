@@ -224,6 +224,10 @@ def _build_and_serve(spec: Dict[str, Any]) -> None:
         port_file=spec.get("port_file"),
         reload_dir=spec.get("reload_dir") or spec.get("load"),
         weights_version=weights_version,
+        # handoff peers (base URLs): a SIGTERM drain migrates in-flight +
+        # queued requests to them (fleet/migration.py) instead of failing
+        # them — the slo_harness --churn drill and the chaos tests set it
+        peers=spec.get("peers"),
     )
 
 

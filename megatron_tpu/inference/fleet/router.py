@@ -30,9 +30,24 @@ routing to it, POST /admin/drain (in-flight requests finish), POST
 and zero decode-step recompiles, by construction and by test
 (tests/test_fleet.py).
 
-Generation requests are pure functions of (prompt, sampling knobs, seed),
-so a retry after a replica death is safe: the replacement replica computes
-the identical response the dead one would have.
+Retry honesty (docs/serving.md): greedy requests and requests carrying a
+`random_seed` are deterministic in (prompt, knobs, seed), so a failover
+retry recomputes the identical response the dead replica would have.
+Sampled requests WITHOUT an explicit seed fall back to the server-side
+default chain — a retry replays that chain, but across mixed weight
+versions (mid rolling update) the replay is not guaranteed to match what
+the dead replica would have emitted, so the router journals
+`serve_retry_resampled` whenever such a request succeeds only after a
+mid-flight replica failure. Handoff drains (drain_replica / SIGTERM with
+peers) avoid the retry entirely: the PRNG chain migrates with the request
+(fleet/migration.py) and the continuation is token-identical.
+
+Global admission (fleet-wide): with `global_max_queue` set, dispatch
+rejects up front when the whole fleet's queue depth (scraped load +
+router-local in-flight counts) is at the bound — an honest fast 503 with
+a fleet-derived Retry-After (queue depth / drain ETA, fleet_retry_after)
+instead of burning an attempt sweep to discover that every replica is
+individually full.
 
 Pure host code: no jax import anywhere in the fleet control plane.
 """
@@ -40,6 +55,7 @@ Pure host code: no jax import anywhere in the fleet control plane.
 from __future__ import annotations
 
 import json
+import math
 import socket
 import threading
 import time
@@ -49,12 +65,38 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 
 from megatron_tpu.inference.fleet import scrape
+from megatron_tpu.inference.fleet.migration import (
+    PrefixDirectory, replicate_prefix,
+)
 from megatron_tpu.telemetry import journal as _journal
 from megatron_tpu.telemetry.metrics import MetricsRegistry, default_registry
 
-#: Retry-After on router-level 503 (no replica available): long enough for
-#: a replica restart or breaker half-open to land
+#: floor/legacy Retry-After on router-level 503 — dispatch now derives the
+#: real hint from fleet state (fleet_retry_after); this constant survives
+#: as the minimum and for callers that imported it
 ROUTER_RETRY_AFTER_SECONDS = 1
+
+
+def fleet_retry_after(queue_depth: float, routable: int,
+                      per_replica_rps: float = 2.0,
+                      drain_eta_s: Optional[float] = None,
+                      min_s: int = ROUTER_RETRY_AFTER_SECONDS,
+                      max_s: int = 60) -> int:
+    """Honest Retry-After from fleet state: the seconds until the fleet
+    can plausibly absorb one more request.
+
+    With routable replicas, that is the time to work off the current
+    fleet-wide queue depth at the fleet's aggregate service rate
+    (`routable * per_replica_rps`). With NONE routable (every replica
+    draining or dead), it is the drain ETA when the caller knows one,
+    else the cap. Clamped to [min_s, max_s] — a Retry-After of 0 invites
+    an immediate re-hit and one beyond the cap parks clients longer than
+    any breaker/drain in this stack lasts."""
+    if routable < 1:
+        eta = max_s if drain_eta_s is None else drain_eta_s
+    else:
+        eta = queue_depth / (routable * max(per_replica_rps, 1e-6))
+    return int(max(min_s, min(max_s, math.ceil(eta))))
 
 
 class NoReplicaAvailableError(RuntimeError):
@@ -108,10 +150,25 @@ class ReplicaRouter:
                  breaker_base_s: float = 0.5,
                  breaker_max_s: float = 15.0,
                  readmit_streak: int = 2,
-                 metrics: Optional[MetricsRegistry] = None):
+                 metrics: Optional[MetricsRegistry] = None,
+                 global_max_queue: Optional[int] = None,
+                 service_rate_rps: float = 2.0):
+        """global_max_queue: fleet-wide admission bound — dispatch answers
+        503 + fleet-derived Retry-After once the summed queue depth
+        (scraped replica load + router in-flight) reaches it, replacing
+        per-replica 503 discovery. service_rate_rps: assumed per-replica
+        completion rate feeding the Retry-After math (fleet_retry_after);
+        calibrate from the SLO harness, not precision-critical — it only
+        shapes the backoff hint."""
         if not urls:
             raise ValueError("router needs at least one replica URL")
         self.replicas = [ReplicaState(u) for u in urls]
+        self.global_max_queue = (int(global_max_queue)
+                                 if global_max_queue is not None else None)
+        self.service_rate_rps = float(service_rate_rps)
+        #: fleet-level prefix directory: which replicas hold which
+        #: registered prefixes (register_prefix fills it)
+        self.prefix_directory = PrefixDirectory()
         self.request_timeout = float(request_timeout)
         self.probe_interval = float(probe_interval)
         self.probe_timeout = float(probe_timeout)
@@ -148,6 +205,9 @@ class ReplicaRouter:
         self._m_dispatch = m.histogram(
             "router_dispatch_seconds",
             "front-door request wall time (retries included)")
+        self._m_admission = m.counter(
+            "router_admission_rejected_total",
+            "requests rejected by the fleet-wide admission bound")
         self._m_ready.set(len(self.replicas))
 
     # ----- health / probing ------------------------------------------------
@@ -277,20 +337,53 @@ class ReplicaRouter:
             rep.breaker_opens = 0
             rep.breaker_open_until = 0.0
 
+    def _fleet_queue_depth(self) -> float:
+        """Fleet-wide queued+running work: the scraped per-replica load
+        (busy slots + queue depth) plus the router's own in-flight counts
+        (the gauges go stale between scrapes). An unreachable replica
+        (load inf) contributes nothing — it holds no work we can count."""
+        with self._lock:
+            return sum((0.0 if r.load == float("inf") else r.load)
+                       + r.outstanding for r in self.replicas)
+
+    def _retry_after(self, depth: Optional[float] = None) -> int:
+        if depth is None:
+            depth = self._fleet_queue_depth()
+        return fleet_retry_after(depth, self._num_routable(),
+                                 per_replica_rps=self.service_rate_rps)
+
     def dispatch(self, body: bytes,
                  timeout: Optional[float] = None
                  ) -> Tuple[int, Dict[str, str], bytes]:
         """Route one /api request; returns (status, headers, body). Every
         failure path is bounded: at most max_attempts tries, each capped
-        by request_timeout, with retry_backoff_s between full sweeps."""
+        by request_timeout, with retry_backoff_s between full sweeps.
+        With global_max_queue set, a fleet at the bound is rejected here
+        (503 + fleet-derived Retry-After) before any attempt is spent."""
         t0 = time.monotonic()
+        if self.global_max_queue is not None:
+            depth = self._fleet_queue_depth()
+            if depth >= self.global_max_queue:
+                retry_after = self._retry_after(depth)
+                self._m_admission.inc()
+                self._m_requests.inc(status="503")
+                self._journal("serve_admission", accepted=False,
+                              queue_depth=round(depth, 1),
+                              bound=self.global_max_queue,
+                              retry_after_s=retry_after)
+                return (503, {"Retry-After": str(retry_after)},
+                        json.dumps({
+                            "message": "fleet at admission bound "
+                                       f"(queue depth {depth:.0f} >= "
+                                       f"{self.global_max_queue}); retry "
+                                       f"after {retry_after}s"}).encode())
         deadline = t0 + (timeout if timeout is not None
                          else self.request_timeout * self.max_attempts)
         tried: set = set()
         attempts = 0
+        failed_mid_flight = False
         last: Tuple[int, Dict[str, str], bytes] = (
-            503, {"Retry-After": str(ROUTER_RETRY_AFTER_SECONDS)},
-            json.dumps({"message": "no replica available"}).encode())
+            503, {}, json.dumps({"message": "no replica available"}).encode())
         while attempts < self.max_attempts and time.monotonic() < deadline:
             rep = self._pick(tried)
             if rep is None and tried:
@@ -316,6 +409,7 @@ class ReplicaRouter:
                     urllib.error.URLError) as e:
                 self._record_failure(rep, f"{type(e).__name__}: {e}")
                 tried.add(rep)
+                failed_mid_flight = True
                 last = (502, {}, json.dumps(
                     {"message": f"replica {rep.url} failed: {e}"}).encode())
                 continue
@@ -338,6 +432,7 @@ class ReplicaRouter:
                 # replica must not open its breaker
                 self._record_failure(rep, f"http {status}")
                 tried.add(rep)
+                failed_mid_flight = True
                 last = (status, headers, rbody)
                 continue
             # success or pass-through client error (4xx, 504 deadline)
@@ -347,18 +442,52 @@ class ReplicaRouter:
             self._m_dispatch.observe(wall)
             if attempts > 1:
                 self._m_failovers.inc()
+            if status == 200 and failed_mid_flight:
+                # retry honesty: a replica may have died MID-generation
+                # and this success is a from-scratch re-run elsewhere —
+                # flag the re-runs whose sampling the client did not pin
+                self._maybe_journal_resample(body, rep, attempts)
             self._journal("serve_route", replica=rep.url, status=status,
                           attempts=attempts, wall_s=round(wall, 6))
             return status, headers, rbody
         # attempt budget or deadline exhausted
-        status = last[0]
+        status, headers, rbody = last
+        if status == 503:
+            # the honest hint: derived from live fleet state at give-up
+            # time, not whatever constant the last replica answered with
+            headers = dict(headers)
+            headers["Retry-After"] = str(self._retry_after())
         wall = time.monotonic() - t0
         self._m_requests.inc(status=str(status))
         self._m_dispatch.observe(wall)
         self._journal("serve_route", replica=None, status=status,
                       attempts=attempts, wall_s=round(wall, 6),
                       exhausted=True)
-        return last
+        return status, headers, rbody
+
+    def _maybe_journal_resample(self, request_body: bytes, rep,
+                                attempts: int) -> None:
+        """Journal `serve_retry_resampled` when a request that succeeded
+        only after a mid-flight replica failure was sampled WITHOUT an
+        explicit random_seed (docs/serving.md "Retry honesty"): greedy
+        and client-seeded requests replay deterministically on the
+        retry, unseeded sampled ones replay the server-default chain —
+        honest under one weight version, not across a mid-update mix."""
+        try:
+            req = json.loads(request_body or b"{}")
+        except ValueError:
+            return
+        if not isinstance(req, dict):
+            return
+        try:
+            temperature = float(req.get("temperature", 1.0))
+            n = int(req.get("tokens_to_generate", 64))
+        except (TypeError, ValueError):
+            return
+        if temperature <= 0.0 or n <= 0 or "random_seed" in req:
+            return
+        self._journal("serve_retry_resampled", replica=rep.url,
+                      attempts=attempts, seeded=False)
 
     # ----- rolling weight update ------------------------------------------
 
@@ -379,11 +508,85 @@ class ReplicaRouter:
         except ValueError:
             return status, {"message": body.decode("utf-8", "replace")}
 
+    def drain_replica(self, url: str, handoff: bool = True,
+                      timeout: float = 60.0) -> Dict[str, Any]:
+        """Drain ONE replica with live-request handoff: stop routing to
+        it, then POST /admin/drain naming the other replicas as handoff
+        peers — its in-flight and queued requests MIGRATE to them
+        (fleet/migration.py) instead of being waited out or failed. The
+        pre-SIGTERM step for scale-down/preemption: after this returns
+        drained=True the replica holds zero client state and can be
+        killed without a single failed request. handoff=False falls back
+        to the classic wait-for-idle drain."""
+        target = url.rstrip("/")
+        rep = next((r for r in self.replicas if r.url == target), None)
+        if rep is None:
+            raise ValueError(f"unknown replica {url!r}")
+        peers = ([r.url for r in self.replicas if r is not rep]
+                 if handoff else [])
+        with self._lock:
+            rep.updating = True   # unroute while the drain runs
+        try:
+            payload: Dict[str, Any] = {"timeout_s": timeout}
+            if peers:
+                payload["handoff"] = peers
+            status, resp = self._admin(rep, "/admin/drain", payload,
+                                       timeout=timeout + self.probe_timeout)
+            self._journal("fleet_drain", replica=rep.url, status=status,
+                          handoff_peers=len(peers),
+                          drained=bool(resp.get("drained")))
+            return {"replica": rep.url, "status": status,
+                    "drained": bool(resp.get("drained")),
+                    "handoff": peers, "response": resp}
+        finally:
+            # routing resumes only when the replica's own /readyz does
+            # (it answers 503 while draining) — clearing the flag just
+            # returns ownership to the prober
+            with self._lock:
+                rep.updating = False
+
+    def register_prefix(self, tokens: List[int],
+                        timeout: float = 60.0) -> Dict[str, Any]:
+        """Fleet-wide prefix (system prompt) registration: prime ONE
+        replica's radix cache with a real prefill, then fan its pages out
+        to every other replica via page export
+        (migration.replicate_prefix) — the prefix becomes a radix hit
+        FLEET-WIDE for the cost of one prefill plus N-1 page transfers,
+        and the prefix_directory records who holds it."""
+        toks = [int(t) for t in tokens]
+        if not toks:
+            raise ValueError("tokens: non-empty int list required")
+        rep = self._pick(set())
+        if rep is None:
+            raise NoReplicaAvailableError(
+                "no routable replica to prime the prefix on")
+        status, resp = self._admin(rep, "/admin/register_prefix",
+                                   {"tokens": toks}, timeout=timeout)
+        if status != 200:
+            raise RuntimeError(
+                f"prefix prime on {rep.url} failed (http {status}): "
+                f"{resp.get('message', resp)}")
+        self.prefix_directory.register(toks, rep.url)
+        dests = [r.url for r in self.replicas if r is not rep]
+        fanout = replicate_prefix(rep.url, dests, toks, timeout=timeout)
+        for entry in fanout["replicated"]:
+            if entry["status"] == 200:
+                self.prefix_directory.register(toks, entry["url"])
+        locations = self.prefix_directory.locations(toks)
+        self._journal("fleet_prefix_register", tokens=len(toks),
+                      primary=rep.url, pages=resp.get("pages"),
+                      locations=len(locations),
+                      wire_bytes=fanout["bytes"])
+        return {"primary": rep.url, "pages": resp.get("pages"),
+                "replicated": fanout["replicated"],
+                "locations": locations, "wire_bytes": fanout["bytes"]}
+
     def rolling_update(self, load: Optional[str] = None,
                        iteration: Optional[int] = None,
                        drain_timeout: float = 60.0,
                        reload_timeout: float = 300.0,
-                       ready_timeout: float = 60.0) -> List[Dict[str, Any]]:
+                       ready_timeout: float = 60.0,
+                       handoff: bool = False) -> List[Dict[str, Any]]:
         """Ship new weights across the fleet under live traffic, one
         replica at a time: unroute -> drain (in-flight requests finish on
         the old weights) -> reload (manifest-verified swap) -> readmit ->
@@ -391,6 +594,13 @@ class ReplicaRouter:
         END by one weight version. Stops at the first failing replica
         (readmitting it with its old weights) so a bad checkpoint can't
         take the whole fleet down; the survivors keep serving.
+
+        handoff=True migrates each replica's in-flight requests to its
+        peers during the drain instead of waiting them out — faster
+        update turns under long-decode traffic, at the cost of those
+        requests finishing on the OLD weights of a peer (which the
+        one-version-per-request claim already allows: the whole request
+        completes on whichever replica finishes it).
 
         Returns one result dict per replica attempted."""
         results: List[Dict[str, Any]] = []
@@ -401,8 +611,12 @@ class ReplicaRouter:
             self._journal("rolling_update_step", replica=rep.url,
                           phase="drain")
             try:
+                drain_payload: Dict[str, Any] = {"timeout_s": drain_timeout}
+                if handoff:
+                    drain_payload["handoff"] = [
+                        r.url for r in self.replicas if r is not rep]
                 status, resp = self._admin(
-                    rep, "/admin/drain", {"timeout_s": drain_timeout},
+                    rep, "/admin/drain", drain_payload,
                     timeout=drain_timeout + self.probe_timeout)
                 out["drain"] = resp
                 if status != 200 or not resp.get("drained"):
@@ -460,7 +674,11 @@ class ReplicaRouter:
         with self._lock:
             reps = [dict(r.snapshot(), breaker_open=r.breaker_open(now))
                     for r in self.replicas]
-        return {"replicas": reps, "routable": self._num_routable()}
+        return {"replicas": reps, "routable": self._num_routable(),
+                "queue_depth": round(self._fleet_queue_depth(), 1),
+                "global_max_queue": self.global_max_queue,
+                "retry_after_s": self._retry_after(),
+                "prefixes": self.prefix_directory.snapshot()}
 
     def _journal(self, kind: str, **fields) -> None:
         j = _journal.get_global_journal()
@@ -498,21 +716,43 @@ def make_router_handler(router: ReplicaRouter):
             if path == "/api":
                 self._proxy()
                 return
-            if path == "/fleet/rolling_update":
+            if path in ("/fleet/rolling_update", "/fleet/drain",
+                        "/fleet/register_prefix"):
                 length = int(self.headers.get("Content-Length", 0))
                 try:
                     req = json.loads(self.rfile.read(length) or b"{}")
                 except ValueError:
                     self._reply(400, {"message": "body must be JSON"})
                     return
-                results = router.rolling_update(
-                    load=req.get("load"), iteration=req.get("iteration"),
-                    drain_timeout=float(req.get("drain_timeout", 60.0)))
-                ok = all("error" not in r for r in results)
-                self._reply(200 if ok else 500, {"results": results})
+                if path == "/fleet/rolling_update":
+                    results = router.rolling_update(
+                        load=req.get("load"),
+                        iteration=req.get("iteration"),
+                        drain_timeout=float(req.get("drain_timeout", 60.0)),
+                        handoff=bool(req.get("handoff", False)))
+                    ok = all("error" not in r for r in results)
+                    self._reply(200 if ok else 500, {"results": results})
+                    return
+                try:
+                    if path == "/fleet/drain":
+                        self._reply(200, router.drain_replica(
+                            str(req.get("url", "")),
+                            handoff=bool(req.get("handoff", True)),
+                            timeout=float(req.get("timeout_s", 60.0))))
+                    else:
+                        self._reply(200, router.register_prefix(
+                            req.get("tokens") or [],
+                            timeout=float(req.get("timeout_s", 60.0))))
+                except NoReplicaAvailableError as e:
+                    self._reply(503, {"message": str(e)})
+                except ValueError as e:
+                    self._reply(400, {"message": str(e)})
+                except RuntimeError as e:
+                    self._reply(502, {"message": str(e)})
                 return
-            self._reply(404, {"message": "POST serves /api and "
-                                         "/fleet/rolling_update"})
+            self._reply(404, {"message": "POST serves /api, /fleet/"
+                                         "rolling_update, /fleet/drain "
+                                         "and /fleet/register_prefix"})
 
         do_POST = _handle_post
         do_PUT = _handle_post

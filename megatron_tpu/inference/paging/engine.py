@@ -695,3 +695,144 @@ class PagedInferenceEngine(InferenceEngine):
         # pages also held by the radix tree stay cached for future hits
         super()._retire(i)
         self._m_pages_free.set(self.pool.free_pages)
+
+    # ----- state migration (fleet/migration.py) ----------------------------
+
+    def _export_slot_kv(self, i: int):
+        """Gather slot i's pages into the canonical [L, T, H, D] wire
+        layout. None when any page of the span is gone (sliding-window
+        release parked it on scratch) — there is no exact KV to ship, so
+        the importer recompute-resumes from the migrated tokens (exact
+        under the deterministic position-based window mask)."""
+        length = int(self.lengths[i])
+        ps = self.page_size
+        if length <= 0:
+            return None
+        n_pages = -(-length // ps)
+        row = self._pending_rows.get(i, self.tables[i])
+        pages = [int(p) for p in row[:n_pages]]
+        if any(p == SCRATCH_PAGE for p in pages):
+            return None
+        host = []
+        for leaf in jax.device_get(self.caches):
+            g = np.asarray(leaf)[:, pages]          # [L, n, ps, H, D]
+            host.append(g.reshape(g.shape[0], n_pages * ps,
+                                  *g.shape[3:])[:, :length])
+        return self._pack_kv_sections(host, length)
+
+    def _page_blocks(self, leaves: List[np.ndarray], j: int):
+        """Page j's [L, page_size, ...] block of each canonical leaf
+        (zero-padded past the committed length)."""
+        ps = self.page_size
+        blocks = []
+        for leaf in leaves:
+            block = np.zeros((leaf.shape[0], ps) + leaf.shape[2:],
+                             leaf.dtype)
+            end = min(leaf.shape[1] - j * ps, ps)
+            block[:, :end] = leaf[:, j * ps:j * ps + end]
+            blocks.append(jnp.asarray(block))
+        return tuple(blocks)
+
+    def _install_request_kv(self, req: Request, kv: dict,
+                            sections) -> bool:
+        """Paged install: allocate the span's pages, write each through
+        the once-jitted page writer, publish the table row, and re-enter
+        the prompt's full pages into the radix tree — the migrated
+        request's prefix lineage survives the hop, so followers sharing
+        its prompt hit on THIS replica too."""
+        i = self._free_slot_for_import()
+        if i is None:
+            return False
+        length = int(kv["length"])
+        ps = self.page_size
+        n_pages = -(-length // ps)
+        pages = self._alloc_pages(n_pages)
+        if pages is None:
+            return False
+        leaves = self._decode_kv_sections(kv, sections)
+        writer = self._kv_install_writer()
+        self._sync_carry()
+        for j, pg in enumerate(pages):
+            self.caches = writer(self.caches, self._page_blocks(leaves, j),
+                                 jnp.int32(pg))
+        row = np.zeros(self.max_pages, np.int32)
+        row[:n_pages] = pages
+        self.tables[i] = row
+        self._table_dirty = True
+        self._admit_counter += 1
+        self._admit_seq[i] = self._admit_counter
+        self._arm_imported_slot(i, req, length)
+        p0 = len(req.prompt)
+        if p0 >= ps and req.prompt_logprobs:
+            # radix-prefix lineage: same full-pages-only rule as
+            # _finish_prefill (the tail page is private — decode writes it)
+            self.prefix_cache.insert(
+                req.prompt, [int(p) for p in row[:p0 // ps]],
+                req.prompt_logprobs)
+        self._m_pages_free.set(self.pool.free_pages)
+        return True
+
+    # ----- fleet prefix directory (cross-replica radix sharing) ------------
+
+    def export_prefix_state(self, tokens):
+        """Package the radix-cached whole-page prefix of `tokens` for
+        replication to a peer: (meta, sections) in the migration wire
+        vocabulary (kind="prefix"), or None when nothing is cached."""
+        toks = [int(t) for t in tokens]
+        with self.paused():
+            pages, lps = self.prefix_cache.lookup(toks)
+            if not pages:
+                return None
+            ps = self.page_size
+            span = len(pages) * ps
+            host = []
+            for leaf in jax.device_get(self.caches):
+                g = np.asarray(leaf)[:, [int(p) for p in pages]]
+                host.append(g.reshape(g.shape[0], span, *g.shape[3:]))
+            kv_meta, sections = self._pack_kv_sections(host, span)
+        meta = {"kind": "prefix", "tokens": toks[:span], "kv": kv_meta}
+        # per-node logprob slices concatenate back into the engine's
+        # (position-1)-indexed prompt_logprobs layout for tokens[1:span]
+        sections["prefix_logprobs"] = (
+            np.concatenate([np.asarray(x, np.float32) for x in lps])
+            if lps else np.zeros(0, np.float32))
+        return meta, sections
+
+    def import_prefix_state(self, meta: dict, sections) -> int:
+        """Install replicated prefix pages into this pool + radix tree.
+        Returns pages added (0 = incompatible, lossy, or already
+        cached). Only EXACT codecs enter the tree — a lossy prefix would
+        silently poison every future request that hits it."""
+        kv = meta.get("kv") or {}
+        ok, _ = self._kv_import_compatible(kv)
+        if not ok or not kv.get("exact"):
+            return 0
+        toks = [int(t) for t in meta.get("tokens", [])]
+        span = int(kv.get("length", 0))
+        ps = self.page_size
+        if span <= 0 or span % ps != 0 or span > len(toks):
+            return 0
+        n_pages = span // ps
+        with self.paused():
+            have, _ = self.prefix_cache.lookup(toks)
+            if len(have) >= n_pages:
+                return 0  # the local copy stays authoritative
+            pages = self._alloc_pages(n_pages)
+            if pages is None:
+                return 0
+            leaves = self._decode_kv_sections(kv, sections)
+            writer = self._kv_install_writer()
+            for j, pg in enumerate(pages):
+                self.caches = writer(self.caches,
+                                     self._page_blocks(leaves, j),
+                                     jnp.int32(pg))
+            lp = np.asarray(sections.get("prefix_logprobs",
+                                         np.zeros(0)), np.float32)
+            added = self.prefix_cache.insert(toks[:span], pages, lp)
+            # insert() retained the refs the tree owns; drop the
+            # allocation refs so the pages become cache-only (evictable
+            # under pressure), and so pages skipped as already-cached
+            # free immediately
+            self.pool.release(pages)
+            self._m_pages_free.set(self.pool.free_pages)
+        return added

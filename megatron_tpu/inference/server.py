@@ -14,9 +14,25 @@ not kill a replica that is merely finishing its in-flight work.
 
 Fleet control plane (POST, docs/serving.md "Fleet"):
 
-  /admin/drain    {"timeout_s": F}  stop admitting (new /api requests get
-                                    503 + Retry-After), wait for in-flight
-                                    requests to finish
+  /admin/drain    {"timeout_s": F, "handoff": [urls]?}
+                                    stop admitting (new /api requests get
+                                    503 + Retry-After); with handoff peers
+                                    (the field, or --serve peers) migrate
+                                    in-flight + queued requests to them,
+                                    else wait for them to finish
+  /admin/import   <binary frame>    accept a migrated request's state
+                                    (fleet/migration.py wire format), run
+                                    it to completion, return its output;
+                                    409 on a torn/corrupt frame
+  /admin/export_prefix {"tokens": [...]}
+                                    pack a cached prefix's KV pages as a
+                                    binary frame (404 when not cached)
+  /admin/import_prefix <binary frame>
+                                    install exported prefix pages into
+                                    the local radix cache
+  /admin/register_prefix {"tokens": [...]}
+                                    ensure a prefix is radix-resident
+                                    (prime with one greedy token if not)
   /admin/readmit  {}                resume admission after a drain
   /admin/reload   {"load": DIR, "iteration": N?}
                                     hot weight reload: manifest-verified
@@ -113,7 +129,8 @@ class GenerationService:
                  draft_cfg=None, draft_params=None,
                  profile_dir: Optional[str] = None,
                  compress_collectives: str = "none",
-                 comm_policy: Optional[str] = None):
+                 comm_policy: Optional[str] = None,
+                 peers: Optional[list] = None):
         """mesh + forward_fn serve sharded models: the mesh becomes
         ambient around generation (GSPMD handles tp/cp), forward_fn is the
         pp>1 pipelined forward (ref ForwardStep, forward_step.py:45-204).
@@ -149,7 +166,14 @@ class GenerationService:
         docs/serving.md) — a no-op unless the mesh has a non-trivial
         tensor axis. comm_policy: path to a site-policy JSON
         (tools/trace_report.py --emit-comm-policy) choosing WHICH
-        collectives compress from measured exposed fractions."""
+        collectives compress from measured exposed fractions.
+
+        peers: base URLs of sibling replicas (http://host:port). A drain
+        (SIGTERM grace or /admin/drain) HANDS OFF in-flight and queued
+        requests to them via the KV migration fabric
+        (fleet/migration.py) instead of failing them — the degradation
+        ladder per request is migrate -> recompute-resume -> retry ->
+        reject, each rung journaled as `serve_migrate`."""
         if kv_cache_int8 and forward_fn is not None:
             # fail at construction, not as a 500 on every request — the
             # pipelined forward threads bf16 cache pairs (the same guard
@@ -193,6 +217,19 @@ class GenerationService:
             label_names=("status",))
         self._m_latency = self.metrics.histogram(
             "server_request_seconds", "API request wall time")
+        self.peers = [str(p).rstrip("/") for p in (peers or [])]
+        self._m_migrations = self.metrics.counter(
+            "server_migrations_total",
+            "request handoffs by degradation-ladder outcome",
+            label_names=("outcome",))
+        # the KV-transfer comm ledger (manifest cost model: bytes on the
+        # wire per migration frame). Deliberately SEPARATE from the
+        # engine_comm_*_bytes_total TP-collective counters so the
+        # compressed-collective ratio math stays uncontaminated.
+        self._m_migrate_bytes = self.metrics.counter(
+            "server_migrate_wire_bytes_total",
+            "KV-state migration wire bytes (manifest cost model)",
+            label_names=("direction",))
         self.engine = None
         if speculative and not engine_slots:
             raise ValueError(
@@ -287,16 +324,30 @@ class GenerationService:
         detail["ok"] = ok
         return ok, detail
 
-    def drain(self, timeout_s: float = 30.0) -> bool:
+    def drain(self, timeout_s: float = 30.0,
+              handoff_urls: Optional[list] = None) -> bool:
         """Stop admitting (new /api requests answer 503 + Retry-After) and
         wait for in-flight work to finish; True when fully drained within
         `timeout_s`. The server keeps serving probes and admin requests —
-        readmit() undoes the drain."""
+        readmit() undoes the drain.
+
+        When handoff peers exist (`handoff_urls`, else the server's
+        configured `peers`), in-flight and queued engine requests are
+        MIGRATED to them first (migrate_out) instead of being waited on —
+        their clients get full responses assembled from the peer's
+        continuation, so a drain costs zero failed requests and near-zero
+        added latency even with minutes of decoding still queued."""
         with self._admin_lock:
             self.draining = True
-            self._journal("serve_drain_begin", timeout_s=timeout_s)
+            peers = [str(p).rstrip("/") for p in
+                     (handoff_urls if handoff_urls else self.peers)]
+            self._journal("serve_drain_begin", timeout_s=timeout_s,
+                          handoff_peers=len(peers))
             deadline = time.monotonic() + timeout_s
-            drained = (self.engine.wait_idle(timeout=timeout_s)
+            if peers and self.engine is not None:
+                self.migrate_out(peers, timeout_s=timeout_s)
+            drained = (self.engine.wait_idle(
+                           timeout=max(deadline - time.monotonic(), 0.001))
                        if self.engine is not None else True)
             if drained:
                 # even with an engine, beam-search and scoring requests
@@ -315,6 +366,221 @@ class GenerationService:
         with self._admin_lock:
             self.draining = False
             self._journal("serve_readmit")
+
+    # ----- KV-state migration (docs/fault_tolerance.md) --------------------
+
+    def migrate_out(self, peers: list, timeout_s: float = 30.0) -> dict:
+        """Hand off every in-flight and queued engine request to a peer.
+
+        export_all_requests atomically empties the engine (its waiters
+        stay blocked on req.done); each exported request then walks the
+        degradation ladder in _handoff_one and its waiter is completed or
+        failed accordingly. Returns {outcome: count}."""
+        deadline = time.monotonic() + timeout_s
+        exported = self.engine.export_all_requests()
+        outcomes: dict = {}
+        for req, meta, sections in exported:
+            budget = max(deadline - time.monotonic(), 0.0)
+            outcome = self._handoff_one(req, meta, sections, peers, budget)
+            outcomes[outcome] = outcomes.get(outcome, 0) + 1
+            self._m_migrations.inc(outcome=outcome)
+        if exported:
+            self._journal("serve_handoff", requests=len(exported),
+                          peers=len(peers), **outcomes)
+        return outcomes
+
+    def _handoff_one(self, req, meta: dict, sections: dict, peers: list,
+                     budget_s: float) -> str:
+        """One request down the degradation ladder:
+
+          migrate    POST the full state (KV pages + scales + chain) to a
+                     peer's /admin/import; the peer finishes the request
+                     token-identically and we complete the client's
+                     response with its output
+          recompute  same transfer WITHOUT the KV sections — the peer
+                     recompute-resumes (teacher-forced prefill over
+                     prompt + generated, exact via the migrated chain)
+          retry      no peer accepted: fail the waiter as overloaded
+                     (503 + Retry-After) so the router re-runs it — safe
+                     for greedy and seeded requests (docs/serving.md)
+          reject     the drain budget is already spent: fail as timed out
+                     (504, non-retryable — the client's budget went with
+                     it)
+
+        Every rung attempt is journaled (`serve_migrate` stage="handoff")
+        and the final outcome as stage="handoff_done". Returns the
+        outcome label."""
+        from megatron_tpu.inference.fleet import migration
+        from megatron_tpu.training import resilience
+
+        deadline = time.monotonic() + budget_s
+
+        def _done(outcome: str) -> str:
+            self._journal("serve_migrate", stage="handoff_done",
+                          outcome=outcome, prompt_len=len(req.prompt),
+                          generated=len(req.generated))
+            return outcome
+
+        rungs = []
+        if "kv" in meta:
+            rungs.append(("migrate", meta, sections))
+        rungs.append(("recompute",
+                      {k: v for k, v in meta.items() if k != "kv"},
+                      {k: v for k, v in sections.items()
+                       if not k.startswith("kv_")}))
+        for rung, m, s in rungs:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            blob = migration.pack_state(m, s)
+            # fault injection: migrate_fail:N tears the first N outbound
+            # transfers — the peer's crc check must reject each one and
+            # this loop must keep walking down the ladder
+            blob = resilience.maybe_corrupt("migrate_fail", blob)
+            for peer in peers:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                t0 = time.monotonic()
+                status, body = migration.post_blob(
+                    peer + "/admin/import", blob, timeout=remaining)
+                ok = status == 200 and isinstance(body, dict)
+                fields = {"stage": "handoff", "rung": rung, "ok": ok,
+                          "peer": peer, "status": status,
+                          "wire_bytes": len(blob),
+                          "wall_s": round(time.monotonic() - t0, 3)}
+                if not ok:
+                    err = (body or {}).get("message") or (
+                        body or {}).get("error")
+                    if err:
+                        fields["error"] = str(err)[:200]
+                self._journal("serve_migrate", **fields)
+                if not ok:
+                    continue
+                self._m_migrate_bytes.inc(len(blob), direction="out")
+                req.generated[:] = [int(t) for t in
+                                    body.get("generated", [])]
+                lp = body.get("logprobs")
+                if lp is not None:
+                    req.logprobs[:] = [float(x) for x in lp]
+                plp = body.get("prompt_logprobs")
+                if plp and not req.prompt_logprobs:
+                    req.prompt_logprobs = [float(x) for x in plp]
+                req._finish()
+                return _done("migrated" if body.get("path") == "kv_import"
+                             else "recomputed")
+        if time.monotonic() >= deadline:
+            self._journal("serve_migrate", stage="handoff", rung="reject",
+                          ok=False, reason="drain budget spent")
+            self.engine._fail_timeout(req, "migrating")
+            return _done("rejected")
+        self._journal("serve_migrate", stage="handoff", rung="retry",
+                      ok=True)
+        req.overloaded = True
+        req._finish(
+            "handoff failed on every peer; request is retryable (the "
+            "fleet router re-runs it — greedy and seeded requests replay "
+            "identically)")
+        return _done("retried")
+
+    def import_state(self, blob: bytes) -> dict:
+        """Accept a migration frame (POST /admin/import): verify the
+        manifest + crc commit contract, rebuild the request in this
+        engine (direct KV install or recompute-resume), run it to
+        completion, and return its output for the exporter to complete
+        the original client's response with. Torn transfers raise
+        MigrationIntegrityError (HTTP 409) BEFORE touching the engine."""
+        from megatron_tpu.inference.fleet import migration
+
+        if self.engine is None:
+            raise ValueError(
+                "state import needs the continuous-batching engine "
+                "(engine_slots > 0)")
+        if self.draining:
+            raise ServiceDrainingError(
+                "server is draining; migrate elsewhere")
+        meta, sections = migration.unpack_state(blob)
+        if meta.get("kind") != "request":
+            raise ValueError(
+                f"expected a request-state frame, got {meta.get('kind')!r}")
+        self._m_migrate_bytes.inc(len(blob), direction="in")
+        req, path = self.engine.import_request_state(meta, sections)
+        budget = meta.get("deadline_remaining_s")
+        if budget is None:
+            budget = self.request_timeout or 60.0
+        if not req.done.wait(timeout=float(budget) + 5.0):
+            raise RequestTimeoutError(
+                "imported request did not complete within its migrated "
+                "deadline")
+        if req.timed_out:
+            raise RequestTimeoutError(req.error or "deadline exceeded")
+        if req.error:
+            raise ValueError(req.error)
+        return {"path": path,
+                "generated": [int(t) for t in req.generated],
+                "logprobs": [float(x) for x in req.logprobs],
+                "prompt_logprobs": [float(x) for x in req.prompt_logprobs]}
+
+    # ----- fleet prefix directory (page export) ----------------------------
+
+    def _paged_engine(self):
+        if self.engine is None or not hasattr(self.engine,
+                                              "export_prefix_state"):
+            raise ValueError(
+                "prefix export/import needs the paged engine (kv_paging)")
+        return self.engine
+
+    def export_prefix_blob(self, tokens: list) -> Optional[bytes]:
+        """Pack a cached prefix's pages for /admin/export_prefix; None
+        when the radix cache holds nothing for it (HTTP 404)."""
+        from megatron_tpu.inference.fleet import migration
+
+        out = self._paged_engine().export_prefix_state(
+            [int(t) for t in tokens])
+        if out is None:
+            return None
+        blob = migration.pack_state(out[0], out[1])
+        self._m_migrate_bytes.inc(len(blob), direction="out")
+        return blob
+
+    def import_prefix_blob(self, blob: bytes) -> dict:
+        """Install a prefix frame into this replica's radix cache (POST
+        /admin/import_prefix): the next prompt sharing the prefix radix-
+        hits here without this replica ever having prefilled it."""
+        from megatron_tpu.inference.fleet import migration
+
+        eng = self._paged_engine()
+        meta, sections = migration.unpack_state(blob)
+        if meta.get("kind") != "prefix":
+            raise ValueError(
+                f"expected a prefix frame, got {meta.get('kind')!r}")
+        self._m_migrate_bytes.inc(len(blob), direction="in")
+        pages = eng.import_prefix_state(meta, sections)
+        self._journal("serve_prefix_import", pages=pages,
+                      wire_bytes=len(blob))
+        return {"pages": pages}
+
+    def register_prefix(self, tokens: list) -> dict:
+        """Ensure a prefix (system prompt) is resident in this replica's
+        radix cache (POST /admin/register_prefix), priming it with one
+        greedy token through the engine if needed. The router calls this
+        on one replica, then fans the resulting pages out to the rest
+        via replicate_prefix (page export, no re-prefill)."""
+        import numpy as np
+
+        eng = self._paged_engine()
+        toks = [int(t) for t in tokens]
+        if not toks:
+            raise ValueError("tokens: non-empty int list required")
+        ps = eng.page_size
+        pages, _ = eng.prefix_cache.lookup(toks)
+        if len(pages) < len(toks) // ps:
+            eng.generate(np.array([toks], np.int32),
+                         np.array([len(toks)], np.int32), max_new_tokens=1)
+            pages, _ = eng.prefix_cache.lookup(toks)
+        self._journal("serve_prefix_register", tokens=len(toks),
+                      pages=len(pages))
+        return {"pages": len(pages), "tokens": len(toks)}
 
     def reload(self, load: Optional[str] = None,
                iteration: Optional[int] = None,
@@ -522,6 +788,17 @@ def make_handler(service: GenerationService):
             length = int(self.headers.get("Content-Length", 0))
             return json.loads(self.rfile.read(length) or b"{}")
 
+        def _read_body(self) -> bytes:
+            length = int(self.headers.get("Content-Length", 0))
+            return self.rfile.read(length)
+
+        def _reply_blob(self, blob: bytes):
+            self.send_response(200)
+            self.send_header("Content-Type", "application/octet-stream")
+            self.send_header("Content-Length", str(len(blob)))
+            self.end_headers()
+            self.wfile.write(blob)
+
         def _handle(self):
             path = self.path.split("?", 1)[0]
             if path.startswith("/admin/"):
@@ -566,10 +843,37 @@ def make_handler(service: GenerationService):
                 service._m_latency.observe(time.monotonic() - t0)
 
         def _handle_admin(self, path: str):
+            from megatron_tpu.inference.fleet.migration import (
+                MigrationIntegrityError,
+            )
             from megatron_tpu.inference.fleet.reload import (
                 NoValidCheckpointError,
             )
 
+            if path in ("/admin/import", "/admin/import_prefix"):
+                # migration frames are binary (manifest + crc contract,
+                # fleet/migration.py) — read raw, never through JSON
+                try:
+                    blob = self._read_body()
+                    if path == "/admin/import":
+                        self._reply(200, service.import_state(blob))
+                    else:
+                        self._reply(200, service.import_prefix_blob(blob))
+                except MigrationIntegrityError as e:
+                    # torn/corrupt transfer: the exporter walks down its
+                    # degradation ladder on this status
+                    self._reply(409, {"message": str(e), "torn": True})
+                except ServiceDrainingError as e:
+                    self._reply(503, {"message": str(e)},
+                                headers=(("Retry-After",
+                                          str(RETRY_AFTER_SECONDS)),))
+                except RequestTimeoutError as e:
+                    self._reply(504, {"message": str(e), "timeout": True})
+                except ValueError as e:
+                    self._reply(400, {"message": str(e)})
+                except Exception as e:  # noqa: BLE001 — server must not die
+                    self._reply(500, {"message": f"admin failed: {e}"})
+                return
             try:
                 req = self._read_json()
             except ValueError:
@@ -578,7 +882,8 @@ def make_handler(service: GenerationService):
             try:
                 if path == "/admin/drain":
                     drained = service.drain(
-                        float(req.get("timeout_s", 30.0)))
+                        float(req.get("timeout_s", 30.0)),
+                        handoff_urls=req.get("handoff"))
                     self._reply(200, {"drained": drained, "draining": True})
                 elif path == "/admin/readmit":
                     service.readmit()
@@ -603,9 +908,22 @@ def make_handler(service: GenerationService):
                         # another capture owns the process-global
                         # profiler session: conflict, retry later
                         self._reply(409, {"message": str(e)})
+                elif path == "/admin/export_prefix":
+                    blob = service.export_prefix_blob(
+                        req.get("tokens") or [])
+                    if blob is None:
+                        self._reply(404,
+                                    {"message": "prefix not cached here"})
+                    else:
+                        self._reply_blob(blob)
+                elif path == "/admin/register_prefix":
+                    self._reply(200, service.register_prefix(
+                        req.get("tokens") or []))
                 else:
                     self._reply(404, {"message": "POST /admin/"
-                                      "{drain,readmit,reload,profile}"})
+                                      "{drain,readmit,reload,profile,"
+                                      "import,export_prefix,"
+                                      "import_prefix,register_prefix}"})
             except NoValidCheckpointError as e:
                 # no verifiable committed checkpoint: an operator/ckpt
                 # problem, not a server fault — 409 so the router's
@@ -677,11 +995,16 @@ def run_server(cfg: ModelConfig, params: Any, tokenizer,
                draft_cfg=None, draft_params=None,
                profile_dir: Optional[str] = None,
                compress_collectives: str = "none",
-               comm_policy: Optional[str] = None) -> None:
+               comm_policy: Optional[str] = None,
+               peers: Optional[list] = None) -> None:
     """Serve until killed. SIGTERM/SIGINT triggers a graceful drain
     (mirroring DistributedSignalHandler): stop admitting (503 +
     Retry-After), finish in-flight requests up to `drain_timeout`, then
     exit cleanly; a second signal force-exits 128+signum immediately.
+    With `peers` configured the drain first HANDS OFF in-flight and
+    queued requests to those replicas over the KV migration fabric
+    (docs/fault_tolerance.md "Serving state migration") — a preempted
+    replica costs zero failed requests, not one retry per client.
     port=0 binds an ephemeral port; `port_file` (fleet subprocess
     choreography) publishes the bound port as {"port": N} once listening.
     warmup=True compiles the decode step before /readyz goes green."""
@@ -704,7 +1027,8 @@ def run_server(cfg: ModelConfig, params: Any, tokenizer,
                                 draft_params=draft_params,
                                 profile_dir=profile_dir,
                                 compress_collectives=compress_collectives,
-                                comm_policy=comm_policy)
+                                comm_policy=comm_policy,
+                                peers=peers)
     server = ThreadingHTTPServer((host, port), make_handler(service))
     bound_port = server.server_address[1]
     if port_file:

@@ -73,6 +73,18 @@ provides
       reject_admission        while armed, every engine submit() is
                               rejected as overloaded (HTTP 503) — drives
                               the router's retry-on-overload path
+      preempt_replica:N       self-deliver SIGTERM right before decode
+                              tick N — a preemption NOTICE mid-stream;
+                              the server's graceful drain hands its
+                              in-flight/queued requests to its handoff
+                              peers (fleet/migration.py) instead of
+                              failing them
+      migrate_fail:N          truncate the first N outbound KV-state
+                              migration transfers (a torn wire); the
+                              importer's manifest+crc commit check must
+                              reject each one and the source must walk
+                              down the migrate -> recompute -> retry
+                              degradation ladder
 
 The env var is re-parsed when its value changes, so tests can monkeypatch
 it without reimporting.
@@ -203,6 +215,35 @@ def maybe_signal(kind: str, iteration: int,
         sys.stderr.flush()
         _journal_fault(kind, iteration=iteration, signal=name)
         os.kill(os.getpid(), signum)
+
+
+_corrupt_counts: Dict[str, int] = {}
+
+
+def maybe_corrupt(kind: str, blob: bytes) -> bytes:
+    """Truncate `blob` for the first N occurrences of the fault (form
+    kind:N) — a torn wire transfer. The receiver's integrity check (crc +
+    committed payload length) must reject the mangled frame; the sender
+    then degrades instead of silently shipping half a KV state. The
+    occurrence counter is process-wide, so `migrate_fail:2` corrupts
+    exactly the first two transfers a replica attempts, whatever requests
+    they carry."""
+    args = fault_args(kind)
+    if args is None:
+        return blob
+    limit = args[0] if args else 1
+    seen = _corrupt_counts.get(kind, 0)
+    if seen >= limit:
+        return blob
+    _corrupt_counts[kind] = seen + 1
+    sys.stderr.write(
+        f"MEGATRON_TPU_FAULT: {kind} corrupting transfer "
+        f"{seen + 1}/{limit} ({len(blob)} bytes)\n")
+    sys.stderr.flush()
+    _journal_fault(kind, transfer=seen + 1, bytes=len(blob))
+    # drop the final third: the manifest header usually survives, the
+    # payload does not — the realistic torn-TCP shape
+    return blob[:max(len(blob) - max(len(blob) // 3, 1), 0)]
 
 
 def host_fault_active(kind: str, host: int, iteration: int) -> bool:

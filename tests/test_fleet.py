@@ -23,7 +23,15 @@ Slow (real subprocess) coverage — the acceptance gates:
     whichever weight version served them;
   * graceful drain on SIGTERM; hung-replica readiness (`hang_replica`);
     the paged-engine variant of router failover; the
-    serve_slo_offered_load bench line.
+    serve_slo_offered_load bench line;
+  * serving churn (docs/fault_tolerance.md "Serving state migration"):
+    SIGTERM-drain and `preempt_replica` hand in-flight/queued requests
+    to a peer over the KV fabric — zero client-visible failures,
+    token-identical answers (greedy AND seeded-sampled), zero decode
+    recompiles on the importer; `migrate_fail` torn transfers walk the
+    migrate -> recompute -> retry degradation ladder with every step
+    journaled. The engine-level migration tests live in
+    test_migration.py.
 """
 
 import json
@@ -1187,3 +1195,220 @@ def test_serve_slo_bench_line_reports_percentiles():
         for q in ("p50", "p95", "p99"):
             v = d[key][q]
             assert v == v and v >= 0, (key, q, v)  # finite, not NaN
+
+
+# ---------------------------------------------------------------------------
+# serving churn: KV-state migration handoff (docs/fault_tolerance.md
+# "Serving state migration")
+
+
+def _journal_events(tel_dir):
+    path = os.path.join(tel_dir, "events.jsonl")
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _scrape_metrics(url):
+    with urllib.request.urlopen(url + "/metrics", timeout=10) as r:
+        return scrape.parse_prom_text(r.read().decode())
+
+
+@pytest.mark.slow  # ~90s: two subprocess warmups + migrated live traffic
+def test_chaos_sigterm_handoff_zero_failures(tmp_path):
+    """SIGTERM one of 2 replicas mid-stream under concurrent traffic: its
+    graceful drain MIGRATES in-flight and queued requests to the peer
+    over the KV fabric — proxy completion keeps every client connection
+    alive, so ZERO requests fail and every answer is token-identical to
+    a solo run, greedy AND seeded-sampled. The source's journal names
+    each handoff outcome; the peer imported real KV bytes and its decode
+    loop never recompiled."""
+    tel0 = str(tmp_path / "tel0")
+    r1 = _spawn(tmp_path, "r1", fault="slow_tick:30")
+    r1.wait_ready(timeout=300)
+    r0 = _spawn(tmp_path, "r0", fault="slow_tick:30", peers=[r1.url],
+                telemetry_dir=tel0, drain_timeout=30.0)
+    router = None
+    try:
+        r0.wait_ready(timeout=300)
+        cases = []
+        for i in range(8):
+            case = {"prompts": [f"{3 + i} {4 + i} {5 + i}"],
+                    "tokens_to_generate": 16}
+            if i % 2:  # half sampled — but SEEDED, so replay-exact
+                case.update(temperature=0.8, random_seed=100 + i)
+            else:
+                case["temperature"] = 0.0
+            cases.append(case)
+        # solo references from the peer (identical seed weights on both
+        # replicas => any replica's solo answer is THE answer)
+        refs = []
+        for c in cases:
+            code, body = _post(r1.url, "/api", c)
+            assert code == 200
+            refs.append(body["text"])
+
+        router = ReplicaRouter([r0.url, r1.url], probe_interval=0.2,
+                               request_timeout=120.0,
+                               metrics=MetricsRegistry()).start()
+        results = [None] * len(cases)
+
+        def client(i):
+            results[i] = router.dispatch(json.dumps(cases[i]).encode())
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(len(cases))]
+        for th in threads:
+            th.start()
+        # ~16 slow ticks per request over 2 slots: 0.6s lands the SIGTERM
+        # with requests both decoding and queued on the victim
+        time.sleep(0.6)
+        r0.terminate()
+        for th in threads:
+            th.join(timeout=300)
+
+        for i in range(len(cases)):
+            status, _, rbody = results[i]
+            assert status == 200, (i, status, rbody)
+            assert json.loads(rbody)["text"] == refs[i], i
+        assert r0.wait(timeout=60) == 0  # graceful exit after the handoff
+
+        # the journal proves the handoff happened and succeeded: every
+        # exported request landed via the lossless rungs of the ladder
+        events = _journal_events(tel0)
+        done = [e for e in events if e.get("kind") == "serve_migrate"
+                and e.get("stage") == "handoff_done"]
+        assert done, "SIGTERM landed after the traffic window"
+        assert all(e["outcome"] in ("migrated", "recomputed")
+                   for e in done), done
+        wire = sum(e.get("wire_bytes", 0) for e in events
+                   if e.get("kind") == "serve_migrate"
+                   and e.get("stage") == "handoff" and e.get("ok"))
+        assert wire > 0  # KV bytes actually crossed the wire
+        assert any(e.get("kind") == "serve_handoff" for e in events)
+
+        # peer side: imports were charged to the migration comm ledger
+        # and the decode loop never recompiled (imported state enters
+        # through the separately-jitted KV writer)
+        samples = _scrape_metrics(r1.url)
+        assert scrape.sample_value(
+            samples, "server_migrate_wire_bytes_total", direction="in") > 0
+        assert scrape.sample_value(
+            samples, "engine_decode_recompiles_total") == 0
+    finally:
+        if router is not None:
+            router.close()
+        r0.close()
+        r1.close()
+
+
+@pytest.mark.slow  # ~80s: preempt_replica self-delivers the SIGTERM
+def test_chaos_preempt_replica_fault_migrates(tmp_path):
+    """`preempt_replica:N` — a preemption notice mid-decode. The replica
+    SIGTERMs itself right before decode tick N; the drain hands its
+    live requests to the peer, so router-fronted clients see zero
+    failures and token-identical answers."""
+    tel0 = str(tmp_path / "tel0")
+    r1 = _spawn(tmp_path, "r1", fault="slow_tick:30")
+    r1.wait_ready(timeout=300)
+    r0 = _spawn(tmp_path, "r0", fault="preempt_replica:12,slow_tick:30",
+                peers=[r1.url], telemetry_dir=tel0, drain_timeout=30.0)
+    router = None
+    try:
+        r0.wait_ready(timeout=300)
+        prompts = [f"{7 + i} {8 + i}" for i in range(4)]
+        refs = {}
+        for p in prompts:
+            code, body = _post(r1.url, "/api",
+                               {"prompts": [p], "tokens_to_generate": 16,
+                                "temperature": 0.0})
+            assert code == 200
+            refs[p] = body["text"]
+        router = ReplicaRouter([r0.url, r1.url], probe_interval=0.2,
+                               request_timeout=120.0,
+                               metrics=MetricsRegistry()).start()
+        results = {}
+
+        def client(p):
+            body = json.dumps({"prompts": [p], "tokens_to_generate": 16,
+                               "temperature": 0.0}).encode()
+            results[p] = router.dispatch(body)
+
+        threads = [threading.Thread(target=client, args=(p,))
+                   for p in prompts]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=300)
+        for p in prompts:
+            status, _, rbody = results[p]
+            assert status == 200, (p, status, rbody)
+            assert json.loads(rbody)["text"] == refs[p], p
+        # the preemption really fired and the exit was graceful
+        assert r0.wait(timeout=120) == 0
+        done = [e for e in _journal_events(tel0)
+                if e.get("kind") == "serve_migrate"
+                and e.get("stage") == "handoff_done"]
+        assert done, "preempt fired with nothing in flight"
+        assert all(e["outcome"] in ("migrated", "recomputed")
+                   for e in done), done
+    finally:
+        if router is not None:
+            router.close()
+        r0.close()
+        r1.close()
+
+
+@pytest.mark.slow  # ~80s: torn-wire fault walks the degradation ladder
+def test_chaos_migrate_fail_walks_degradation_ladder(tmp_path):
+    """`migrate_fail:N` truncates every outbound migration frame. The
+    peer's manifest+crc commit check rejects each rung (migrate, then
+    recompute) — nothing is half-imported — and the source degrades to
+    the honest-retry rung: the client gets a retryable 503, replays on
+    the peer token-identically, and the journal names every step."""
+    tel0 = str(tmp_path / "tel0")
+    r1 = _spawn(tmp_path, "r1")
+    r1.wait_ready(timeout=300)
+    r0 = _spawn(tmp_path, "r0", fault="migrate_fail:8,slow_tick:30",
+                peers=[r1.url], telemetry_dir=tel0, drain_timeout=30.0)
+    try:
+        r0.wait_ready(timeout=300)
+        case = {"prompts": ["5 6 7"], "tokens_to_generate": 30,
+                "temperature": 0.0}
+        code, ref = _post(r1.url, "/api", case)
+        assert code == 200
+        result = {}
+
+        def client():
+            result["r"] = _post(r0.url, "/api", case)
+
+        th = threading.Thread(target=client)
+        th.start()
+        time.sleep(0.4)  # mid-decode: 30 tokens at 30ms/tick ~= 0.9s
+        r0.terminate()
+        th.join(timeout=120)
+        code, body = result["r"]
+        # both lossless rungs were torn => honest retryable rejection,
+        # NOT a silent half-import
+        assert code == 503, (code, body)
+        # the replay (what the router does on a 503) is token-identical
+        code, body = _post(r1.url, "/api", case)
+        assert code == 200 and body["text"] == ref["text"]
+        assert r0.wait(timeout=60) == 0
+
+        events = _journal_events(tel0)
+        hand = [e for e in events if e.get("kind") == "serve_migrate"
+                and e.get("stage") == "handoff"
+                and e.get("rung") in ("migrate", "recompute")]
+        assert {e.get("rung") for e in hand} >= {"migrate", "recompute"}
+        # every torn transfer was rejected by the peer's crc check
+        assert not any(e.get("ok") for e in hand), hand
+        done = [e for e in events if e.get("kind") == "serve_migrate"
+                and e.get("stage") == "handoff_done"]
+        assert done and done[0]["outcome"] == "retried", done
+        retry_rows = [e for e in events
+                      if e.get("kind") == "serve_migrate"
+                      and e.get("rung") == "retry"]
+        assert retry_rows, "ladder's retry rung was not journaled"
+    finally:
+        r0.close()
+        r1.close()

@@ -17,6 +17,13 @@ or spawn a throwaway local fleet of tiny deterministic replicas first
 
   python tools/slo_harness.py --spawn 2 --requests 64 --offered_rps 4
 
+--churn (with --spawn >= 2) is the serving-churn drill
+(docs/fault_tolerance.md "Serving state migration"): replica 0 is
+spawned with the others as handoff peers, then SIGTERMed mid-window —
+its graceful drain MIGRATES in-flight and queued requests to the peers
+over the KV fabric, so the gate stays "failed": 0 even though a replica
+died under load. Exit code 1 if any client-visible request failed.
+
 Output is one JSON report on stdout (percentiles in seconds). The
 `serve_slo_offered_load` bench.py line is this harness inlined.
 """
@@ -54,6 +61,14 @@ def parse_args(argv=None):
                     help="per-request client timeout")
     ap.add_argument("--engine_slots", type=int, default=2,
                     help="slots per spawned replica (--spawn)")
+    ap.add_argument("--churn", action="store_true",
+                    help="SIGTERM replica 0 mid-window (needs --spawn "
+                         ">= 2); its drain hands in-flight requests off "
+                         "to the surviving peers — the zero-failures "
+                         "gate still applies")
+    ap.add_argument("--churn_at", type=float, default=0.5,
+                    help="when to deliver the SIGTERM, as a fraction of "
+                         "the trace window")
     return ap.parse_args(argv)
 
 
@@ -70,33 +85,85 @@ def run_attached(args) -> dict:
 
 
 def run_spawned(args) -> dict:
+    import threading
+
     from megatron_tpu.inference.fleet import slo
     from megatron_tpu.inference.fleet.replica import ReplicaProcess
     from megatron_tpu.inference.fleet.router import RouterServer
 
+    if args.churn and args.spawn < 2:
+        raise SystemExit("--churn needs --spawn >= 2 (the victim's "
+                         "requests must have somewhere to migrate)")
     with tempfile.TemporaryDirectory(prefix="slo_fleet_") as tmp:
         replicas = []
+
+        def _spawn(i, peers=None):
+            spec = {"preset": "tiny",
+                    "cfg": {"vocab_size": args.vocab, "seq_length": 64},
+                    "seed": 0, "engine_slots": args.engine_slots,
+                    "port": 0, "warmup": True,
+                    "port_file": os.path.join(tmp, f"r{i}.port")}
+            if peers:
+                spec["peers"] = peers
+            rep = ReplicaProcess(
+                spec, log_path=os.path.join(tmp, f"r{i}.log")).spawn()
+            replicas.append(rep)
+            return rep
+
         try:
-            for i in range(args.spawn):
-                spec = {"preset": "tiny",
-                        "cfg": {"vocab_size": args.vocab, "seq_length": 64},
-                        "seed": 0, "engine_slots": args.engine_slots,
-                        "port": 0, "warmup": True,
-                        "port_file": os.path.join(tmp, f"r{i}.port")}
-                replicas.append(ReplicaProcess(
-                    spec, log_path=os.path.join(tmp, f"r{i}.log")).spawn())
+            # replicas 1..N-1 first: their bound URLs become replica 0's
+            # handoff peers, so a churn SIGTERM on 0 migrates its live
+            # requests instead of failing them
+            for i in range(1, args.spawn):
+                _spawn(i)
             for rep in replicas:
                 rep.wait_ready(timeout=300)
+            victim = _spawn(0, peers=[r.url for r in replicas]
+                            if args.churn else None)
+            victim.wait_ready(timeout=300)
             router = RouterServer([r.url for r in replicas]).start()
             try:
                 trace = slo.make_trace(args.requests, args.offered_rps,
                                        seed=args.seed, vocab=args.vocab,
                                        new_tokens=args.new_tokens)
+                churn_timer = None
+                churn_at_s = None
+                fire_lock = threading.Lock()
+                fired = []
+
+                def _sigterm_victim():
+                    # exactly-once: a second SIGTERM takes the server's
+                    # force-exit path instead of the graceful drain
+                    with fire_lock:
+                        if fired:
+                            return
+                        fired.append(True)
+                    victim.terminate()
+
+                if args.churn:
+                    window_s = max(e["at_s"] for e in trace)
+                    churn_at_s = round(window_s * args.churn_at, 3)
+                    churn_timer = threading.Timer(churn_at_s,
+                                                  _sigterm_victim)
+                    churn_timer.daemon = True
+                    churn_timer.start()
                 report = slo.run_slo(
                     router.url + "/api",
                     [r.url + "/metrics" for r in replicas], trace,
                     args.offered_rps, timeout=args.timeout)
                 report["spawned_replicas"] = args.spawn
+                if args.churn:
+                    churn_timer.cancel()
+                    _sigterm_victim()  # window beat the timer: drill now
+                    try:
+                        exit_code = victim.wait(timeout=60)
+                    except Exception:
+                        exit_code = None
+                    report["churn"] = {
+                        "victim": victim.url,
+                        "sigterm_at_s": churn_at_s,
+                        "victim_exit": exit_code,
+                    }
                 return report
             finally:
                 router.close()

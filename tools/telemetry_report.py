@@ -246,7 +246,34 @@ def _summarize_serving(events: List[Dict[str, Any]]
     routes = [e for e in events if e.get("kind") == "serve_route"]
     specs = [e for e in events if e.get("kind") == "serve_spec"]
     comms = [e for e in events if e.get("kind") == "comm_policy"]
+    migrations = [e for e in events if e.get("kind") == "serve_migrate"]
+    resampled = sum(1 for e in events
+                    if e.get("kind") == "serve_retry_resampled")
     out: Dict[str, Any] = {}
+    if migrations or resampled:
+        # churn ledger (docs/fault_tolerance.md "Serving state
+        # migration"): handoff outcomes down the degradation ladder
+        # (migrated > recomputed > retried > rejected), importer-side
+        # path split, and the KV wire bytes the manifest cost model
+        # charged for successful transfers
+        by_outcome: Dict[str, int] = {}
+        for e in migrations:
+            if e.get("stage") == "handoff_done":
+                o = str(e.get("outcome", "?"))
+                by_outcome[o] = by_outcome.get(o, 0) + 1
+        import_paths: Dict[str, int] = {}
+        for e in migrations:
+            if e.get("stage") == "import":
+                p = str(e.get("path", "?"))
+                import_paths[p] = import_paths.get(p, 0) + 1
+        wire = sum(int(e.get("wire_bytes", 0)) for e in migrations
+                   if e.get("stage") == "handoff" and e.get("ok"))
+        mig: Dict[str, Any] = {"by_outcome": by_outcome,
+                               "imports_by_path": import_paths,
+                               "wire_bytes": wire}
+        if resampled:
+            mig["retries_resampled"] = resampled
+        out["migrations"] = mig
     if comms:
         # one comm_policy record per engine build (docs/serving.md
         # "Compressed collectives"): which TP collectives run
@@ -438,6 +465,22 @@ def render(summary: Dict[str, Any]) -> str:
                          f"{f['readmits']} readmits | "
                          f"{f['drains']} drains | "
                          f"{f['weight_reloads']} weight reloads")
+        if "migrations" in sv:
+            m = sv["migrations"]
+            by = m.get("by_outcome", {})
+            ladder = " | ".join(
+                f"{by.get(o, 0)} {o}" for o in
+                ("migrated", "recomputed", "retried", "rejected"))
+            lines.append(f"  migrations: {ladder} | "
+                         f"{m.get('wire_bytes', 0)} KV wire bytes")
+            if m.get("imports_by_path"):
+                lines.append("  migration imports: " + " | ".join(
+                    f"{v} {k}" for k, v in
+                    sorted(m["imports_by_path"].items())))
+            if m.get("retries_resampled"):
+                lines.append(f"  unseeded sampled retries (journaled "
+                             f"serve_retry_resampled): "
+                             f"{m['retries_resampled']}")
     if summary.get("faults"):
         lines.append(f"injected faults: {summary['faults']}")
     if summary.get("divergences"):
