@@ -105,6 +105,17 @@ CONFIGS: Dict[str, Callable[[], Any]] = {
     # fp8(e4m3) transport variant of the same step
     "decode_tp2_fp8": lambda: _targets().tp_decode_step_target(
         "decode_tp2_fp8", mode="fp8"),
+    # context-parallel serving decode on a tp=2 x cp=2 mesh: the TP
+    # psum/all_gather ledger PLUS the per-layer ring — (cp-1) ppermute
+    # hops moving normalized (out, lse) attention partials between the
+    # sequence-striped KV pool shards. jaxpr-only (full-manual
+    # shard_map; see moe_ep2)
+    "decode_tp2_cp2": lambda: _targets().cp_paged_decode_step_target(
+        "decode_tp2_cp2"),
+    # context-parallel chunked prefill at cp=2: one [1, C] prompt chunk
+    # scatter-written into the striped pools + ring-attended — the
+    # distributed long-prompt prefill ledger
+    "prefill_cp2": lambda: _targets().cp_chunk_step_target("prefill_cp2"),
 }
 
 #: the compressed-vs-dense pairs --check verifies the wire-byte

@@ -291,6 +291,89 @@ def tp_decode_step_target(name: str = "decode_tp2_dense",
                        mesh=rt.mesh)
 
 
+def cp_paged_decode_step_target(name: str = "decode_tp2_cp2",
+                                tp: int = 2, cp: int = 2,
+                                num_slots: int = 4) -> AuditTarget:
+    """The context-parallel serving engine's batched decode step on a
+    TP x CP mesh: per-layer ring attention over the sequence-striped
+    page pools — (cp-1) ppermute hops per layer moving the normalized
+    (out, lse) partials — composed with the explicit TP collectives
+    (attn_out/mlp_out psum + the vocab-parallel logits all_gather).
+    The manifest is the dense CP ring ledger the compressed cp_ring
+    policy diffs against. jaxpr-only: like moe_ep2, compiling the
+    full-manual shard_map output back into GSPMD context RET_CHECK-
+    crashes the baked XLA (compat.py), so can_compile=False."""
+    from megatron_tpu.config import ParallelConfig
+    from megatron_tpu.inference.context_parallel import ContextParallelEngine
+    from megatron_tpu.models.params import init_params, param_specs
+    from megatron_tpu.parallel.mesh import build_mesh
+    from megatron_tpu.parallel.sharding import shard_tree
+
+    cfg = tiny_model()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rt = build_mesh(ParallelConfig(tensor_parallel=tp, context_parallel=cp),
+                    devices=jax.devices()[:tp * cp])
+    sparams = shard_tree(rt, params, param_specs(cfg))
+    eng = ContextParallelEngine(
+        cfg, sparams, num_slots=num_slots, max_seq_len=cfg.seq_length,
+        page_size=8, prefill_chunk=16, mesh=rt.mesh, force_donate=True,
+        compress_collectives="dense", cp_collectives="dense")
+    N = num_slots
+    args = (
+        _sds(sparams),
+        _sds(eng.caches),
+        jax.ShapeDtypeStruct((cp, N, eng._mpl), jnp.int32),  # local tables
+        jax.ShapeDtypeStruct((N,), jnp.int32),      # last_tok
+        jax.ShapeDtypeStruct((N,), jnp.int32),      # lengths
+        jax.ShapeDtypeStruct((N, 2), jnp.uint32),   # keys
+        jax.ShapeDtypeStruct((N,), jnp.float32),    # temps
+        jax.ShapeDtypeStruct((N,), jnp.int32),      # top_ks
+        jax.ShapeDtypeStruct((N,), jnp.float32),    # top_ps
+    )
+    return AuditTarget(name=name, fn=eng._decode_step, args=args,
+                       mesh=rt.mesh, can_compile=False)
+
+
+def cp_chunk_step_target(name: str = "prefill_cp2",
+                         cp: int = 2) -> AuditTarget:
+    """The context-parallel chunked-prefill step at cp=2 (tp=1): one
+    [1, C] chunk of one prompt scatter-written into the striped pools
+    and ring-attended — the distributed-prefill half of the CP serving
+    ledger. Same jaxpr-only caveat as decode_tp2_cp2."""
+    from megatron_tpu.config import ParallelConfig
+    from megatron_tpu.inference.context_parallel import ContextParallelEngine
+    from megatron_tpu.models.params import init_params, param_specs
+    from megatron_tpu.parallel.mesh import build_mesh
+    from megatron_tpu.parallel.sharding import shard_tree
+
+    cfg = tiny_model()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rt = build_mesh(ParallelConfig(context_parallel=cp),
+                    devices=jax.devices()[:cp])
+    sparams = shard_tree(rt, params, param_specs(cfg))
+    eng = ContextParallelEngine(
+        cfg, sparams, num_slots=4, max_seq_len=cfg.seq_length,
+        page_size=8, prefill_chunk=16, mesh=rt.mesh, force_donate=True,
+        cp_collectives="dense")
+    C = eng.prefill_chunk
+    args = (
+        _sds(sparams),
+        _sds(eng.caches),
+        jax.ShapeDtypeStruct((cp, 1, eng._mpl), jnp.int32),  # local table
+        jax.ShapeDtypeStruct((1, C + 1), jnp.int32),  # tokens_ext
+        jax.ShapeDtypeStruct((), jnp.int32),          # off
+        jax.ShapeDtypeStruct((), jnp.int32),          # write_start
+        jax.ShapeDtypeStruct((), jnp.int32),          # write_end
+        jax.ShapeDtypeStruct((), jnp.int32),          # sample_pos
+        jax.ShapeDtypeStruct((2,), jnp.uint32),       # key
+        jax.ShapeDtypeStruct((), jnp.float32),        # temp
+        jax.ShapeDtypeStruct((), jnp.int32),          # top_k
+        jax.ShapeDtypeStruct((), jnp.float32),        # top_p
+    )
+    return AuditTarget(name=name, fn=eng._chunk_step, args=args,
+                       mesh=rt.mesh, can_compile=False)
+
+
 def spec_paged_decode_step_target(name: str = "decode_spec_paged",
                                   dtype: str = "bfloat16",
                                   num_slots: int = 4,

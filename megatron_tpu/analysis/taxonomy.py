@@ -99,6 +99,11 @@ def wire_bytes_per_call(primitive: str, payload_bytes: int,
         return payload_bytes * (n - 1) // n
     if primitive in ("reduce_scatter", "psum_scatter"):
         return payload_bytes * (n - 1)
+    if primitive in ("ppermute", "pbroadcast"):
+        # ring-permute / broadcast: each device sends and receives the
+        # payload exactly once per hop (the CP ring-attention transport,
+        # inference/context_parallel/ring_kv.py)
+        return payload_bytes
     return payload_bytes
 
 # An HLO instruction name is the op mnemonic plus an optional

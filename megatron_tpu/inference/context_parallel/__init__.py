@@ -1,0 +1,24 @@
+"""Context-parallel serving: distributed chunked prefill + sequence-
+sharded paged KV (ISSUE 19).
+
+The paged serving stack (inference/paging/) keeps one host-side view of
+every request — radix prefix cache, chunked prefill queue, preempt/
+resume, sliding-window release — while this package re-homes the
+device-side KV under it: the page pools are striped over the CP mesh
+axis (logical page l of any sequence lives on rank ``l % cp``), each
+chunk-prefill and decode step runs cross-shard attention through a
+ring of ``ppermute`` hops (ops/ring_attention.py's merge algebra over
+the paged pools), and the ring transport itself is policy-gated
+compressible (quant/collectives.CpComm).
+
+Exactness contract: greedy decode through the CP engine is
+token-identical to the dense single-host engine, with logprob rows
+matching to fp32 merge tolerance (tests/test_context_parallel.py).
+"""
+
+from megatron_tpu.inference.context_parallel.engine import (  # noqa: F401
+    ContextParallelEngine,
+)
+from megatron_tpu.inference.context_parallel.pool import (  # noqa: F401
+    StripedPagePool,
+)

@@ -1,0 +1,231 @@
+"""ContextParallelEngine: paged serving with sequence-sharded KV.
+
+Subclass of PagedInferenceEngine that keeps EVERY host-side policy
+unchanged — one global radix prefix tree, one chunked-prefill queue,
+LIFO preemption, sliding-window release, the global [N, max_pages]
+table rows — and restructures only the device side:
+
+  * the KV page pools are sharded over the "context" mesh axis on the
+    pages dimension, and allocation is striped so logical page l of any
+    row lives on CP rank ``l % cp`` (pool.StripedPagePool);
+  * the decode/chunk steps receive PER-RANK local tables
+    ([cp, rows, pages_per_rank], sharded on dim 0) instead of the flat
+    global row, which routes the per-layer attention through the
+    ring-attention island (ring_kv.paged_ring_attention): each rank
+    attends its own sequence stripe, cp-1 ``ppermute`` hops merge the
+    normalized partials;
+  * the hop transport is quant/collectives.CpComm — dense fp32 or
+    policy-gated int8/fp8 (site "cp_ring"), composable with the
+    existing TP compressed collectives on a TP x CP mesh.
+
+Because the host bookkeeping is inherited verbatim, radix hits,
+mid-prefill preempt/resume and ragged prompt tails are exact by the
+same arguments as the single-host paged engine; the parity gates in
+tests/test_context_parallel.py pin greedy token identity against the
+dense engine. int8 KV pools and speculative decoding are out of scope
+(both rejected at build).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megatron_tpu.config import ModelConfig
+from megatron_tpu.inference.context_parallel.pool import StripedPagePool
+from megatron_tpu.inference.paging.engine import PagedInferenceEngine
+from megatron_tpu.inference.paging.pool import SCRATCH_PAGE
+from megatron_tpu.inference.paging.radix import RadixPrefixCache
+from megatron_tpu.parallel.mesh import AXIS_CONTEXT
+from megatron_tpu.quant.collectives import cp_ring_comm_bytes, make_cp_comm
+
+
+class ContextParallelEngine(PagedInferenceEngine):
+    """Paged serving engine over a TP x CP mesh (tp >= 1, cp >= 2)."""
+
+    def __init__(self, cfg: ModelConfig, params: Any, num_slots: int = 8,
+                 max_seq_len: Optional[int] = None,
+                 page_size: int = 16, prefill_chunk: int = 32,
+                 num_pages: Optional[int] = None,
+                 vocab_size: Optional[int] = None, mesh=None,
+                 want_logprobs: bool = True, metrics=None,
+                 flight_recorder=None,
+                 force_donate: Optional[bool] = None,
+                 max_queue: Optional[int] = None,
+                 compress_collectives: str = "none",
+                 comm_policy=None,
+                 comm_chunk: int = 32,
+                 cp_collectives: str = "dense",
+                 cp_comm_policy=None):
+        if mesh is None:
+            raise ValueError(
+                "ContextParallelEngine requires a mesh with a non-trivial "
+                f"'{AXIS_CONTEXT}' axis")
+        cp = dict(mesh.shape).get(AXIS_CONTEXT, 1)
+        if cp <= 1:
+            raise ValueError(
+                f"ContextParallelEngine needs {AXIS_CONTEXT} >= 2 on the "
+                f"mesh (got {cp}); use PagedInferenceEngine for cp == 1")
+        self.cp = cp
+        # set BEFORE super().__init__: the inherited step builders close
+        # over cp_comm, and _fresh_caches rounds the pool to cp shards
+        self.cp_comm = make_cp_comm(mesh, cp_collectives, cfg=cfg,
+                                    policy=cp_comm_policy, chunk=comm_chunk)
+        if self.cp_comm is None:
+            raise ValueError(
+                f"cp_collectives={cp_collectives!r} disables the ring "
+                "transport the CP engine is built on (use 'dense', 'int8' "
+                "or 'fp8')")
+        self._cp_bytes_for = {}
+        super().__init__(
+            cfg, params, num_slots=num_slots, max_seq_len=max_seq_len,
+            kv_cache_int8=False, page_size=page_size,
+            prefill_chunk=prefill_chunk, num_pages=num_pages,
+            vocab_size=vocab_size, mesh=mesh,
+            want_logprobs=want_logprobs, metrics=metrics,
+            flight_recorder=flight_recorder, force_donate=force_donate,
+            max_queue=max_queue, speculative=None,
+            compress_collectives=compress_collectives,
+            comm_policy=comm_policy, comm_chunk=comm_chunk)
+        self._npl = self.num_pages // cp          # pool pages per rank
+        self._mpl = -(-self.max_pages // cp)      # table slots per rank
+        if self._npl - 1 < self._mpl:
+            raise ValueError(
+                f"num_pages={self.num_pages} over cp={cp} leaves "
+                f"{self._npl} pages per rank — rank 0 (minus scratch) "
+                f"cannot hold one full sequence ({self._mpl} pages)")
+        # re-home the allocator: striped per-rank free lists under the
+        # SAME refcount/scratch contract (nothing is allocated yet — the
+        # base constructor only sized the pool)
+        self.pool = StripedPagePool(self.num_pages, cp)
+        self.prefix_cache = RadixPrefixCache(self.pool, self.page_size)
+        self._m_pages_free.set(self.pool.free_pages)
+
+        self._cp_bytes_for = {
+            id(self._comm_tick_bytes): cp_ring_comm_bytes(
+                cfg, self.cp_comm, num_slots, 1),
+            id(self._comm_chunk_bytes): cp_ring_comm_bytes(
+                cfg, self.cp_comm, 1, self.prefill_chunk),
+        }
+        self.stats.update({"cp_ring_steps": 0, "cp_comm_dense_bytes": 0,
+                           "cp_comm_compressed_bytes": 0})
+        m = self.metrics
+        self._m_cp_ring = m.counter(
+            "engine_cp_ring_steps_total",
+            "context-parallel ring hops executed (per layer per forward)")
+        self._m_cp_dense = m.counter(
+            "engine_cp_comm_dense_bytes_total",
+            "wire bytes the CP ring hops would move dense")
+        self._m_cp_comp = m.counter(
+            "engine_cp_comm_compressed_bytes_total",
+            "wire bytes the CP ring hops move at the configured mode")
+        self._m_cp_shard_free = m.gauge(
+            "engine_cp_shard_pages_free",
+            "free pages in each CP rank's pool shard",
+            label_names=("shard",))
+        self._set_shard_gauges()
+
+    # ----- cache + shape policy -------------------------------------------
+
+    def _fresh_caches(self):
+        """Same pools as the paged engine, with the page count rounded up
+        to a multiple of cp so every rank holds an equal shard (the
+        striping arithmetic and the P(None, context, ...) placement both
+        need exact divisibility)."""
+        if self.num_pages is None:
+            max_pages = -(-self.max_seq_len // self.page_size)
+            self.num_pages = self.num_slots * max_pages + 1
+        self.num_pages += (-self.num_pages) % self.cp
+        return super()._fresh_caches()
+
+    def _kv_sharding(self):
+        """Pool placement: pages sharded over "context" — each rank holds
+        its sequence stripe's pages. Heads stay replicated over "tensor":
+        the ring island is full-manual over every mesh axis (compat.py
+        shard_map shim), so a tensor-sharded heads dim would just be
+        force-gathered at the island boundary each step."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return NamedSharding(self.mesh,
+                             P(None, AXIS_CONTEXT, None, None, None))
+
+    # ----- page accounting -------------------------------------------------
+
+    def _alloc_pages(self, n: int,
+                     logical_start: int = 0) -> Optional[List[int]]:
+        """Striped allocation with per-rank-aware eviction: a failed
+        alloc means SOME rank's shard is dry, so evict LRU cache-only
+        pages (whatever ranks hold them) and retry until the striped
+        grab fits or eviction runs dry."""
+        pages = self.pool.alloc(n, logical_start)
+        while pages is None and self.prefix_cache.evict(max(n, 1)) > 0:
+            pages = self.pool.alloc(n, logical_start)
+        if pages is not None:
+            self._m_pages_free.set(self.pool.free_pages)
+        return pages
+
+    # ----- device tables ---------------------------------------------------
+
+    def _loc_tables(self, rows: np.ndarray) -> np.ndarray:
+        """Global table rows [M, max_pages] -> per-rank local tables
+        [cp, M, mpl]: entry [r, i, j] is rank r's LOCAL pool index of
+        logical page ``j*cp + r`` of row i. Unallocated entries (global
+        SCRATCH_PAGE) map to local scratch on rank 0 (same masked-write
+        semantics as the flat engine) and to the out-of-range sentinel
+        ``npl`` elsewhere (writes drop, reads are masked)."""
+        rows = np.asarray(rows, np.int32)
+        cp, npl, mpl = self.cp, self._npl, self._mpl
+        loc = np.full((cp, rows.shape[0], mpl), npl, np.int32)
+        for r in range(cp):
+            cols = rows[:, r::cp]
+            if ((cols != SCRATCH_PAGE) & (cols // npl != r)).any():
+                raise AssertionError(
+                    f"page-striping invariant violated on rank {r}: a "
+                    "logical page maps outside its owner's pool shard")
+            loc[r, :, :cols.shape[1]] = np.where(
+                cols == SCRATCH_PAGE, 0 if r == 0 else npl, cols - r * npl)
+        return loc
+
+    def _cp_table_device(self, loc: np.ndarray):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sh = NamedSharding(self.mesh, P(AXIS_CONTEXT))
+        return jax.device_put(jnp.asarray(loc), sh)
+
+    def _decode_extra_args(self):
+        if self._table_dirty or self._device_table is None:
+            self._device_table = self._cp_table_device(
+                self._loc_tables(self.tables))
+            self._table_dirty = False
+        return (self._device_table,)
+
+    def _chunk_table_arg(self, row):
+        return self._cp_table_device(
+            self._loc_tables(np.asarray(row)[None, :]))
+
+    # ----- telemetry -------------------------------------------------------
+
+    def _count_comm(self, bytes_pair) -> None:
+        super()._count_comm(bytes_pair)
+        cp_pair = self._cp_bytes_for.get(id(bytes_pair))
+        if cp_pair is None:
+            return
+        hops = (self.cp - 1) * self.cfg.num_layers
+        self.stats["cp_ring_steps"] += hops
+        self.stats["cp_comm_dense_bytes"] += cp_pair["dense"]
+        self.stats["cp_comm_compressed_bytes"] += cp_pair["compressed"]
+        self._m_cp_ring.inc(hops)
+        self._m_cp_dense.inc(cp_pair["dense"])
+        self._m_cp_comp.inc(cp_pair["compressed"])
+
+    def _set_shard_gauges(self) -> None:
+        for r, free in enumerate(self.pool.free_pages_by_rank()):
+            self._m_cp_shard_free.set(free, shard=str(r))
+
+    def step(self) -> int:
+        served = super().step()
+        self._set_shard_gauges()
+        return served

@@ -1,0 +1,165 @@
+"""Ring attention over sequence-striped paged KV pools.
+
+The device half of the CP serving engine: one partial-manual shard_map
+island over the "context" mesh axis that (1) scatter-writes the new
+K/V rows into the LOCAL pool shard (each rank owns the pages of its
+sequence stripe — logical page l lives on rank ``l % cp``), (2) runs
+the exact masked attention of ops/attention.py against the local
+stripe only, producing a normalized (out, lse) partial, and (3) merges
+the cp partials with cp-1 ``ppermute`` ring hops and the
+ring-attention merge algebra (ops/ring_attention._merge_normalized).
+The hop transport is quant/collectives.ring_permute — dense fp32 or
+policy-gated int8/fp8 (site "cp_ring").
+
+Mask semantics mirror ops/attention.py exactly so the CP engine stays
+token-identical to the dense one:
+
+  * decode (per_slot): key position g attends iff ``g < lengths[i] + 1``
+    (+ the sliding-window floor), lengths being the pre-increment slot
+    length — same as the dense engine's ``kv_lengths = cache_index + 1``.
+  * chunk prefill: ``g <= off + q_idx`` causal, window ``g > q_pos - w``.
+
+The local tables arriving here are PER-RANK views ([cp, rows, mpl],
+sharded on dim 0): entry [r, i, j] holds rank r's local pool index of
+logical page ``j*cp + r`` of row i, or the sentinel ``npl`` (== local
+pool size) when that logical page is unallocated on r or out of the
+row's span. Sentinel writes drop (scatter mode="drop"); sentinel reads
+are masked out of the softmax.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from megatron_tpu.ops.ring_attention import _merge_normalized
+from megatron_tpu.quant.collectives import ring_permute
+
+
+def paged_ring_attention(cpc, q, k_new, v_new, kv_cache, loc_tables,
+                         cache_index, per_slot, page_write_start=None,
+                         page_write_end=None, sliding_window=None):
+    """Cross-shard paged attention for one layer.
+
+    q [B, S, Hq, D]; k_new/v_new [B, S, Hkv, D] (post-rope);
+    kv_cache = (k_pool, v_pool) each [num_pages, page_size, Hkv, D]
+    sharded over "context" on the pages dim; loc_tables [cp, B_t, mpl]
+    sharded over "context" on dim 0. per_slot decode: cache_index =
+    lengths [B] and S must be 1. Chunk prefill: cache_index = scalar
+    chunk offset, B == 1, and the write fences bound the page writes.
+    Returns (ctx [B, S, Hq, D] in q.dtype, (k_pool, v_pool) updated).
+    """
+    k_pool, v_pool = kv_cache
+    cp, axis = cpc.cp, cpc.axis
+    if per_slot and q.shape[1] != 1:
+        raise ValueError(
+            "context-parallel paged decode serves one token per slot "
+            f"(no speculative rows); got S={q.shape[1]}")
+    if not per_slot and q.shape[0] != 1:
+        raise ValueError(
+            f"context-parallel chunk prefill needs batch 1, got "
+            f"{q.shape[0]}")
+    if per_slot:
+        page_write_start = jnp.int32(0)
+        page_write_end = jnp.int32(2 ** 30)
+    window = sliding_window
+
+    def inner(qx, kn, vn, kp, vp, loc, idx, ws, we):
+        r = jax.lax.axis_index(axis)
+        npl, ps = kp.shape[0], kp.shape[1]
+        loc = loc[0]                               # [B_t, mpl] local view
+        mpl = loc.shape[1]
+        B, S, Hq, D = qx.shape
+        Hkv = kn.shape[2]
+
+        # -- scatter-write this step's K/V into the local stripe -------
+        if per_slot:
+            pos = idx                              # [B] write positions
+            lpage = pos // ps
+            j = jnp.minimum(lpage // cp, mpl - 1)
+            phys = jnp.take_along_axis(loc, j[:, None], axis=1)[:, 0]
+            owned = (lpage % cp) == r
+            tgt = jnp.where(owned, phys, npl)
+            kp = kp.at[tgt, pos % ps].set(kn[:, 0].astype(kp.dtype),
+                                          mode="drop")
+            vp = vp.at[tgt, pos % ps].set(vn[:, 0].astype(vp.dtype),
+                                          mode="drop")
+        else:
+            pos = idx + jnp.arange(S, dtype=jnp.int32)   # [S]
+            lpage = pos // ps
+            j = jnp.minimum(lpage // cp, mpl - 1)
+            phys = jnp.take(loc[0], j, mode="clip")
+            owned = ((lpage % cp) == r) & (pos >= ws) & (pos < we)
+            tgt = jnp.where(owned, phys, npl)
+            kp = kp.at[tgt, pos % ps].set(kn[0].astype(kp.dtype),
+                                          mode="drop")
+            vp = vp.at[tgt, pos % ps].set(vn[0].astype(vp.dtype),
+                                          mode="drop")
+
+        # -- gather the local stripe + its global token positions ------
+        safe = jnp.minimum(loc, npl - 1)           # [B_t, mpl]
+        kf = jnp.take(kp, safe, axis=0, mode="clip")
+        vf = jnp.take(vp, safe, axis=0, mode="clip")
+        s_loc = mpl * ps
+        kf = kf.reshape(loc.shape[0], s_loc, Hkv, D)
+        vf = vf.reshape(loc.shape[0], s_loc, Hkv, D)
+        g_pos = ((jnp.arange(mpl, dtype=jnp.int32) * cp + r) * ps)[:, None] \
+            + jnp.arange(ps, dtype=jnp.int32)[None, :]
+        g_pos = g_pos.reshape(s_loc)               # global position per key
+        valid = jnp.repeat(loc != npl, ps, axis=1)  # [B_t, s_loc]
+
+        # -- exact masked partial softmax (ops/attention.py semantics) --
+        scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+        qf = qx.astype(jnp.float32) * scale
+        groups = Hq // Hkv
+        qg = qf.reshape(B, S, Hkv, groups, D)
+        scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg,
+                            kf.astype(jnp.float32))
+        if per_slot:
+            kv_len = idx[:, None, None] + 1        # pre-increment length
+            allowed = g_pos[None, None, :] < kv_len
+            if window is not None:
+                allowed &= g_pos[None, None, :] >= kv_len - window
+        else:
+            q_pos = (idx + jnp.arange(S, dtype=jnp.int32))[None, :, None]
+            allowed = g_pos[None, None, :] <= q_pos
+            if window is not None:
+                allowed &= g_pos[None, None, :] > q_pos - window
+        allowed &= valid[:, None, :]               # [B, S, s_loc]
+        scores = jnp.where(allowed[:, None, None], scores, -jnp.inf)
+        m_raw = jnp.max(scores, axis=-1)           # [B, Hkv, G, S]
+        m_safe = jnp.where(jnp.isfinite(m_raw), m_raw, 0.0)
+        p = jnp.exp(scores - m_safe[..., None])    # exp(-inf) == 0
+        tot = jnp.sum(p, axis=-1)                  # [B, Hkv, G, S]
+        o = jnp.einsum("bhgqk,bkhd->bqhgd", p, vf.astype(jnp.float32))
+        tot_t = tot.transpose(0, 3, 1, 2)          # [B, S, Hkv, G]
+        o = o / jnp.maximum(tot_t, 1e-30)[..., None]
+        lse = jnp.where(tot_t > 0.0,
+                        m_safe.transpose(0, 3, 1, 2)
+                        + jnp.log(jnp.maximum(tot_t, 1e-30)),
+                        -jnp.inf)
+        o = o.reshape(B, S, Hq, D)
+        lse = lse.reshape(B, S, Hq)
+
+        # -- ring merge: cp-1 hops, all ranks end with the full result --
+        perm = [(i, (i + 1) % cp) for i in range(cp)]
+        acc_o, acc_lse = o, lse
+        cur_o, cur_lse = o, lse
+        for _ in range(cp - 1):
+            cur_o = ring_permute(cur_o, axis, perm, mode=cpc.wire_mode(),
+                                 chunk=cpc.chunk)
+            cur_lse = jax.lax.ppermute(cur_lse, axis, perm)
+            acc_o, acc_lse = _merge_normalized((acc_o, acc_lse),
+                                               cur_o, cur_lse)
+        return acc_o.astype(qx.dtype), kp, vp
+
+    shard = P(axis)
+    ctx, k_pool, v_pool = jax.shard_map(
+        inner, mesh=cpc.mesh,
+        in_specs=(P(), P(), P(), shard, shard, shard, P(), P(), P()),
+        out_specs=(P(), shard, shard),
+        axis_names={axis}, check_vma=False)(
+            q, k_new, v_new, k_pool, v_pool, loc_tables,
+            cache_index, page_write_start, page_write_end)
+    return ctx, (k_pool, v_pool)

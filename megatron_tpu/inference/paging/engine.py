@@ -212,6 +212,10 @@ class PagedInferenceEngine(InferenceEngine):
     def _build_decode_step(self):
         cfg, vocab, wlp = self.cfg, self.vocab_size, self.want_logprobs
         tp_comm = self.tp_comm
+        # the CP engine sets cp_comm before super().__init__ so the same
+        # builders serve it — a 3-D device table then routes the forward
+        # through the ring-attention island (models/transformer.py)
+        cp_comm = getattr(self, "cp_comm", None)
         from functools import partial
 
         from megatron_tpu.models.language_model import lm_forward
@@ -228,7 +232,8 @@ class PagedInferenceEngine(InferenceEngine):
                                         kv_caches=caches,
                                         cache_index=lengths,
                                         page_table=table,
-                                        tp_comm=tp_comm)
+                                        tp_comm=tp_comm,
+                                        cp_comm=cp_comm)
             logits = logits[:, 0]
             split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
             new_keys, subs = split[:, 0], split[:, 1]
@@ -248,6 +253,7 @@ class PagedInferenceEngine(InferenceEngine):
         cfg, vocab, wlp = self.cfg, self.vocab_size, self.want_logprobs
         C = self.prefill_chunk
         tp_comm = self.tp_comm
+        cp_comm = getattr(self, "cp_comm", None)
         from functools import partial
 
         from megatron_tpu.models.language_model import lm_forward
@@ -274,7 +280,8 @@ class PagedInferenceEngine(InferenceEngine):
                                         page_table=table_row,
                                         page_write_start=write_start,
                                         page_write_end=write_end,
-                                        tp_comm=tp_comm)
+                                        tp_comm=tp_comm,
+                                        cp_comm=cp_comm)
             if wlp:
                 lsm = jax.nn.log_softmax(logits[0].astype(jnp.float32),
                                          axis=-1)
@@ -325,10 +332,14 @@ class PagedInferenceEngine(InferenceEngine):
 
     # ----- page accounting -------------------------------------------------
 
-    def _alloc_pages(self, n: int) -> Optional[List[int]]:
+    def _alloc_pages(self, n: int,
+                     logical_start: int = 0) -> Optional[List[int]]:
         """n fresh pages, evicting LRU cache-only prefix pages if the
         free list can't cover it. None = still dry (caller defers or
-        preempts)."""
+        preempts). logical_start is the logical page index the run
+        starts at within its row — ignored here, but the CP engine's
+        striped pool draws each page from the rank owning that logical
+        slot (inference/context_parallel/pool.py)."""
         pages = self.pool.alloc(n)
         if pages is None:
             self.prefix_cache.evict(n - self.pool.free_pages)
@@ -403,7 +414,8 @@ class PagedInferenceEngine(InferenceEngine):
         # an eviction here would free a hit page and hand it back as
         # "fresh", mapping one physical page at two logical blocks
         self.pool.retain(hit_pages)
-        fresh = self._alloc_pages(n_prompt_pages - len(hit_pages))
+        fresh = self._alloc_pages(n_prompt_pages - len(hit_pages),
+                                  logical_start=len(hit_pages))
         if fresh is None:
             self.pool.release(hit_pages)
             if self.num_active == 0:
@@ -473,7 +485,7 @@ class PagedInferenceEngine(InferenceEngine):
         t0 = time.monotonic()
         try:
             tok, lp, plp, caches, key = self._chunk_step(
-                self.params, self.caches, jnp.asarray(row[None, :]),
+                self.params, self.caches, self._chunk_table_arg(row),
                 jnp.asarray(toks_ext), jnp.int32(off),
                 jnp.int32(task.write_start), jnp.int32(task.total),
                 jnp.int32(task.total - 1), jnp.asarray(task.key),
@@ -485,7 +497,7 @@ class PagedInferenceEngine(InferenceEngine):
                 # table row and write fences
                 self.draft_caches = self._draft_chunk_step(
                     self.draft_params, self.draft_caches,
-                    jnp.asarray(row[None, :]),
+                    self._chunk_table_arg(row),
                     jnp.asarray(toks_ext[:, :C]), jnp.int32(off),
                     jnp.int32(task.write_start), jnp.int32(task.total))
         except Exception as e:  # noqa: BLE001 - a failing chunk must fail
@@ -610,7 +622,7 @@ class PagedInferenceEngine(InferenceEngine):
                 for pg in range(first, last_pg + 1):
                     if self.tables[i, pg] != SCRATCH_PAGE:
                         continue
-                    pages = self._alloc_pages(1)
+                    pages = self._alloc_pages(1, logical_start=pg)
                     if pages is None:
                         if not self._preempt_one():
                             # unreachable: slot i itself is preemptible
@@ -636,6 +648,12 @@ class PagedInferenceEngine(InferenceEngine):
             self._device_table = self._commit_small(jnp.asarray(self.tables))
             self._table_dirty = False
         return (self._device_table,)
+
+    def _chunk_table_arg(self, row):
+        """Device form of one pending table row for the chunk step
+        ([1, max_pages] here; the CP engine rebuilds it as per-rank
+        local tables sharded over the context axis)."""
+        return jnp.asarray(row[None, :])
 
     def _release_window_pages(self) -> None:
         """Sliding-window page release (Mistral; ROADMAP item 1): pages

@@ -130,6 +130,9 @@ class GenerationService:
                  profile_dir: Optional[str] = None,
                  compress_collectives: str = "none",
                  comm_policy: Optional[str] = None,
+                 cp_serving: bool = False,
+                 cp_collectives: str = "dense",
+                 cp_comm_policy: Optional[str] = None,
                  peers: Optional[list] = None):
         """mesh + forward_fn serve sharded models: the mesh becomes
         ambient around generation (GSPMD handles tp/cp), forward_fn is the
@@ -167,6 +170,16 @@ class GenerationService:
         tensor axis. comm_policy: path to a site-policy JSON
         (tools/trace_report.py --emit-comm-policy) choosing WHICH
         collectives compress from measured exposed fractions.
+
+        cp_serving (--serve_context_parallel; docs/serving.md
+        "Context-parallel long-context serving"): shard every sequence's
+        paged KV over the mesh's "context" axis and run decode/prefill
+        attention as a ring over the shards — million-token prompts
+        whose KV exceeds one device's HBM. Needs kv_paging and a mesh
+        with context >= 2; greedy output stays token-identical to the
+        single-host paged engine. cp_collectives ("dense"|"int8"|"fp8")
+        picks the ring-hop transport; cp_comm_policy is a site-policy
+        JSON gating the "cp_ring" site.
 
         peers: base URLs of sibling replicas (http://host:port). A drain
         (SIGTERM grace or /admin/drain) HANDS OFF in-flight and queued
@@ -243,7 +256,31 @@ class GenerationService:
                 spec_cfg = SpecConfig(k=spec_k, drafter=speculative,
                                       draft_cfg=draft_cfg,
                                       draft_params=draft_params)
-            if kv_paging:
+            if cp_serving:
+                from megatron_tpu.inference.context_parallel import (
+                    ContextParallelEngine,
+                )
+
+                if not kv_paging:
+                    raise ValueError(
+                        "context-parallel serving runs over the paged "
+                        "engine — enable kv_paging")
+                if kv_cache_int8 or spec_cfg is not None:
+                    raise ValueError(
+                        "context-parallel serving supports neither int8 "
+                        "KV pools nor speculative decoding")
+                self.engine = ContextParallelEngine(
+                    cfg, params, num_slots=engine_slots,
+                    max_seq_len=engine_max_seq_len,
+                    page_size=page_size, prefill_chunk=prefill_chunk,
+                    num_pages=num_pages,
+                    vocab_size=tokenizer.vocab_size, mesh=mesh,
+                    metrics=self.metrics, max_queue=engine_max_queue,
+                    compress_collectives=compress_collectives,
+                    comm_policy=comm_policy,
+                    cp_collectives=cp_collectives,
+                    cp_comm_policy=cp_comm_policy)
+            elif kv_paging:
                 from megatron_tpu.inference.paging import PagedInferenceEngine
 
                 self.engine = PagedInferenceEngine(
@@ -996,6 +1033,9 @@ def run_server(cfg: ModelConfig, params: Any, tokenizer,
                profile_dir: Optional[str] = None,
                compress_collectives: str = "none",
                comm_policy: Optional[str] = None,
+               cp_serving: bool = False,
+               cp_collectives: str = "dense",
+               cp_comm_policy: Optional[str] = None,
                peers: Optional[list] = None) -> None:
     """Serve until killed. SIGTERM/SIGINT triggers a graceful drain
     (mirroring DistributedSignalHandler): stop admitting (503 +
@@ -1028,6 +1068,9 @@ def run_server(cfg: ModelConfig, params: Any, tokenizer,
                                 profile_dir=profile_dir,
                                 compress_collectives=compress_collectives,
                                 comm_policy=comm_policy,
+                                cp_serving=cp_serving,
+                                cp_collectives=cp_collectives,
+                                cp_comm_policy=cp_comm_policy,
                                 peers=peers)
     server = ThreadingHTTPServer((host, port), make_handler(service))
     bound_port = server.server_address[1]
@@ -1085,6 +1128,10 @@ def run_server(cfg: ModelConfig, params: Any, tokenizer,
 
     mode = (f"continuous batching, {engine_slots} slots"
             + (", paged KV + prefix cache" if kv_paging else "")
+            + (f", context-parallel KV (cp="
+               f"{getattr(service.engine, 'cp', 0)}, "
+               f"ring {getattr(getattr(service.engine, 'cp_comm', None), 'mode', '?')})"
+               if cp_serving else "")
             + (f", speculative ({speculative}, k={spec_k})"
                if speculative else "")
             + (f", compressed collectives ({service.engine.tp_comm.mode}, "

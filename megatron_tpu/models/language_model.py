@@ -213,6 +213,7 @@ def lm_forward(
     page_write_start: Optional[jnp.ndarray] = None,
     page_write_end: Optional[jnp.ndarray] = None,
     tp_comm=None,  # quant.TpComm: explicit/compressed TP collectives
+    cp_comm=None,  # quant.CpComm: context-parallel ring transport
 ):
     """Forward pass to logits.
 
@@ -248,8 +249,14 @@ def lm_forward(
     if cfg.position_embedding_type == "rotary":
         if kv_caches is not None and page_table is not None:
             # paged pools are [L, num_pages, page_size, ...]: the logical
-            # max length is the table width x page size, not shape[2]
-            rope_len = page_table.shape[1] * kv_caches[0].shape[2]
+            # max length is the table width x page size, not shape[2].
+            # A context-parallel table ([cp, rows, pages_per_rank]) covers
+            # cp x pages_per_rank logical pages per row.
+            if getattr(page_table, "ndim", 2) == 3:
+                rope_len = (page_table.shape[0] * page_table.shape[2]
+                            * kv_caches[0].shape[2])
+            else:
+                rope_len = page_table.shape[1] * kv_caches[0].shape[2]
         elif kv_caches is not None:
             rope_len = kv_caches[0].shape[2]  # cache max length
         else:
@@ -275,6 +282,7 @@ def lm_forward(
             page_write_start=page_write_start,
             page_write_end=page_write_end,
             tp_comm=tp_comm,
+            cp_comm=cp_comm,
         )
         return (y, aux + moe_aux), new_cache
 

@@ -68,6 +68,7 @@ def attention_block(
     page_write_start: Optional[jnp.ndarray] = None,  # scalar int32
     page_write_end: Optional[jnp.ndarray] = None,    # scalar int32
     tp_comm=None,  # quant.TpComm: explicit/compressed TP collectives
+    cp_comm=None,  # quant.CpComm: context-parallel ring transport
 ) -> Tuple[jnp.ndarray, Optional[Tuple[jnp.ndarray, jnp.ndarray]]]:
     """Returns (out [B,S,h], updated kv_cache).
 
@@ -134,13 +135,27 @@ def attention_block(
     per_slot = getattr(cache_index, "ndim", 0) == 1
 
     paged = page_table is not None
+    # a 3-D page table ([cp, rows, pages_per_rank], sharded over the
+    # "context" mesh axis) selects the context-parallel paged path: the
+    # KV pools are sequence-striped and attention runs as a ring over
+    # per-rank partials (inference/context_parallel/ring_kv.py)
+    cp_paged = paged and getattr(page_table, "ndim", 2) == 3
     if paged:
         if kv_cache is None:
             raise ValueError("page_table requires a (paged) kv_cache")
-        cp_prefill = False  # paged serving is single-chip scope, like int8
+        cp_prefill = False  # paged serving replaces it with the ring path
         if not per_slot and b != 1:
             raise ValueError(
                 f"paged chunked prefill is single-row (batch {b})")
+    if cp_paged:
+        if cp_comm is None:
+            raise ValueError(
+                "a [cp, rows, pages] page table requires cp_comm "
+                "(quant/collectives.make_cp_comm)")
+        if len(kv_cache) == 4:
+            raise ValueError(
+                "context-parallel paged serving does not support int8 "
+                "KV pools (stripe the bf16 pools instead)")
 
     def _paged_write(store, new):
         """Scatter new rows through the page table. Decode: new [B,1,...]
@@ -174,7 +189,17 @@ def attention_block(
 
     q_offset = 0
     kv_lengths = None
-    if paged and len(kv_cache) == 4:
+    ctx = None
+    if cp_paged:
+        from megatron_tpu.inference.context_parallel.ring_kv import (
+            paged_ring_attention,
+        )
+
+        ctx, kv_cache = paged_ring_attention(
+            cp_comm, q, k, v, kv_cache, page_table, cache_index,
+            per_slot, page_write_start, page_write_end,
+            sliding_window=cfg.sliding_window_size)
+    elif paged and len(kv_cache) == 4:
         # int8 paged pools: quantize the new rows on write, dequantize the
         # whole pool for attention — the same numerics as the dense int8
         # slot cache (quantize-once, dequantize-everything), so the paged
@@ -272,21 +297,23 @@ def attention_block(
         raise ValueError(
             "attn_mask_type='padding' requires an attention_mask input — "
             "running without one would silently attend to pad tokens")
-    ctx = attention(
-        q, k, v,
-        mask_type=("bidirectional" if cfg.attn_mask_type == "padding"
-                   else cfg.attn_mask_type),
-        padding_mask=padding_mask,
-        sliding_window=cfg.sliding_window_size,
-        dropout=cfg.attention_dropout if attn_dropout_key is not None else 0.0,
-        dropout_rng=attn_dropout_key,
-        q_offset=q_offset,
-        impl=cfg.attention_impl,
-        softmax_fp32=cfg.softmax_fp32,
-        kv_lengths=kv_lengths,
-        page_table=page_table,
-        flash_bwd=cfg.flash_bwd,
-    )
+    if ctx is None:
+        ctx = attention(
+            q, k, v,
+            mask_type=("bidirectional" if cfg.attn_mask_type == "padding"
+                       else cfg.attn_mask_type),
+            padding_mask=padding_mask,
+            sliding_window=cfg.sliding_window_size,
+            dropout=(cfg.attention_dropout
+                     if attn_dropout_key is not None else 0.0),
+            dropout_rng=attn_dropout_key,
+            q_offset=q_offset,
+            impl=cfg.attention_impl,
+            softmax_fp32=cfg.softmax_fp32,
+            kv_lengths=kv_lengths,
+            page_table=page_table,
+            flash_bwd=cfg.flash_bwd,
+        )
     if tp_comm is not None and "attn_out" in tp_comm.sites:
         # explicit row-parallel reduction (dense psum or the compressed
         # quantize->all_to_all->reduce->all_gather; quant/collectives.py)
@@ -346,6 +373,7 @@ def block_forward(
     page_write_start: Optional[jnp.ndarray] = None,
     page_write_end: Optional[jnp.ndarray] = None,
     tp_comm=None,
+    cp_comm=None,
 ) -> Tuple[jnp.ndarray, Optional[Tuple[jnp.ndarray, jnp.ndarray]], jnp.ndarray]:
     """One decoder layer -> (y, kv_cache, moe_aux_loss).
 
@@ -370,6 +398,7 @@ def block_forward(
         page_write_start=page_write_start,
         page_write_end=page_write_end,
         tp_comm=tp_comm,
+        cp_comm=cp_comm,
     )
     attn_out = _dropout(attn_out, rate, k_hidden1 if cfg.hidden_dropout > 0 else None)
 

@@ -12,6 +12,9 @@ fraction — the Flash Communication number — and
     exposed fraction clears the threshold.
   * ``logits``  — the vocab-parallel logits gather (all-gather at
     runtime): compressed when the all-gather exposed fraction clears it.
+  * ``cp_ring`` — the context-parallel ring-attention hop
+    (collective-permute at runtime, inference/context_parallel/):
+    compressed when the collective-permute exposed fraction clears it.
 
 ``tools/trace_report.py --emit-comm-policy OUT.json`` writes the derived
 policy; serving loads it back with ``--serve_comm_policy OUT.json``.
@@ -28,15 +31,22 @@ import dataclasses
 import json
 from typing import Any, Dict, Mapping, Optional
 
-#: the compressible TP collective sites in the serving forward
+#: the compressible collective sites in the serving forward
 #: (models/transformer.py attention_block + mlp_block, models/
-#: language_model.py lm_logits) and the HLO collective kind each one
-#: runs as — the join key between trace exposure and site policy.
+#: language_model.py lm_logits, inference/context_parallel/ring_kv.py)
+#: and the HLO collective kind each one runs as — the join key between
+#: trace exposure and site policy.
 SITE_COLLECTIVES: Dict[str, str] = {
     "attn_out": "all-reduce",
     "mlp_out": "all-reduce",
     "logits": "all-gather",
+    "cp_ring": "collective-permute",
 }
+
+#: the subset of sites living inside the TENSOR-parallel comm plan
+#: (TpComm): "cp_ring" belongs to the context-parallel ring transport
+#: (CpComm) and must never reach TpComm's width validation.
+TP_SITES = ("attn_out", "mlp_out", "logits")
 
 #: no-measurement default: compress everything (the static Flash-
 #: Communication stance; a trace-derived policy prunes hidden ones)

@@ -70,6 +70,11 @@ def test_bench_main_emits_one_json_line(monkeypatch):
         functools.partial(bench.serve_compressed_comm_bench,
                           num_slots=2, new_tokens=8, reps=1))
     monkeypatch.setattr(
+        bench, "serve_longctx_prefill_bench",
+        functools.partial(bench.serve_longctx_prefill_bench,
+                          prompt_len=48, prefill_chunk=16, new_tokens=2,
+                          reps=1, cfg=tiny_headline()))
+    monkeypatch.setattr(
         bench, "train_attention_bwd_bench",
         functools.partial(bench.train_attention_bwd_bench, s=128, d=32,
                           iters=1))
@@ -80,7 +85,7 @@ def test_bench_main_emits_one_json_line(monkeypatch):
     # full (non-quick) runs: the serving metric lines + the preemption
     # notice-budget line + the flash-bwd gate line, then the headline
     # LAST (the only positional contract the driver relies on)
-    assert len(lines) == 8
+    assert len(lines) == 9
     serve = json.loads(lines[0])
     assert serve["metric"] == "serve_decode_throughput_toks_per_s"
     assert set(serve) >= {"metric", "value", "unit", "vs_baseline"}
@@ -111,18 +116,28 @@ def test_bench_main_emits_one_json_line(monkeypatch):
     assert comm["value"] >= 3.0, comm
     assert comm["detail"]["decode_recompiles_after_warmup"] == 0
     assert comm["detail"]["counter_compressed_bytes"] > 0
-    slo = json.loads(lines[4])
+    lctx = json.loads(lines[4])
+    assert lctx["metric"] == "serve_longctx_prefill"
+    assert "error" not in lctx, lctx
+    # the deterministic gates: CP chunked prefill + ring decode stay
+    # token-identical to the single-host paged engine, zero recompiles
+    # (throughput vs_baseline is informational on CPU fake devices)
+    assert lctx["value"] > 0, lctx
+    assert lctx["detail"]["greedy_tokens_match_single_host"], lctx
+    assert lctx["detail"]["decode_recompiles_after_warmup"] == 0
+    assert lctx["detail"]["cp_ring_steps"] > 0
+    slo = json.loads(lines[5])
     assert slo["metric"] == "serve_slo_offered_load"
     assert "error" not in slo, slo
     # every request must complete (a lost request zeroes the line) and
     # the percentile block must be populated
     assert slo["value"] > 0 and slo["detail"]["failed"] == 0, slo
     assert set(slo["detail"]["ttft_s"]) == {"p50", "p95", "p99"}
-    pre = json.loads(lines[5])
+    pre = json.loads(lines[6])
     assert pre["metric"] == "preempt_save_latency_ms"
     assert "error" not in pre, pre
     assert pre["value"] > 0
-    fb = json.loads(lines[6])
+    fb = json.loads(lines[7])
     assert fb["metric"] == "train_attention_bwd_speedup"
     assert "error" not in fb, fb
     # the deterministic gate: the gradient jaxpr contains the template's
@@ -215,7 +230,7 @@ def test_bench_probe_retries_until_backend_up(monkeypatch):
     # test_bench_main_emits_one_json_line + the slow speedup gate)
     for leg in ("serving_engine_bench", "serve_prefix_cache_bench",
                 "serve_speculative_bench", "serve_compressed_comm_bench",
-                "serve_slo_bench"):
+                "serve_longctx_prefill_bench", "serve_slo_bench"):
         monkeypatch.setattr(
             bench, leg,
             lambda deadline, _leg=leg, **kw: {"metric": _leg, "value": 0.0})

@@ -43,6 +43,21 @@ def test_comm_report_cli_check():
     assert "comm contracts: OK" in out.stdout
 
 
+@pytest.mark.slow  # subprocess retrace ~3s on a loaded 2-core host; the
+# in-process jaxpr golden check (test_analysis) covers both CP configs
+# in tier-1
+def test_comm_report_cli_check_cp():
+    # the context-parallel chunked-prefill manifest: ring ppermute
+    # ledger must rebuild clean (one --config per name — the flag
+    # appends single values)
+    out = _run([os.path.join("tools", "comm_report.py"), "--check",
+                "--config", "prefill_cp2"],
+               env_extra={"XLA_FLAGS":
+                          "--xla_force_host_platform_device_count=8"})
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "comm contracts: OK" in out.stdout
+
+
 def test_comm_report_cli_diff():
     # the dense-vs-compressed reduction as one command (ISSUE 15
     # satellite) — reads golden JSON only, no jax import
@@ -62,9 +77,10 @@ def test_trace_report_cli_emit_comm_policy(tmp_path):
     assert out.returncode == 0, out.stdout + out.stderr
     doc = json.loads(pol.read_text())
     # the fixture's all-reduce is 87% exposed => psum sites compress;
-    # no all-gather was measured => the logits site stays dense
+    # no all-gather / collective-permute was measured => the logits and
+    # cp_ring sites stay dense
     assert doc["sites"] == {"attn_out": True, "mlp_out": True,
-                            "logits": False}
+                            "logits": False, "cp_ring": False}
     assert doc["exposure"]["all-reduce"] > 0.8
 
 

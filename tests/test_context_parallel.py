@@ -1,0 +1,264 @@
+"""Context-parallel serving (inference/context_parallel/): striped page
+pool units, compressed ring-permute transport, and the engine parity
+gates — greedy traffic through the CP engine (chunked distributed
+prefill, sequence-striped paged KV, ring-attention decode) must be
+token-identical to the dense single-host engine, with logprob parity
+and zero decode recompiles after warmup, through radix prefix hits and
+mid-prefill preempt/resume."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megatron_tpu.config import ParallelConfig
+from megatron_tpu.inference.context_parallel import (
+    ContextParallelEngine, StripedPagePool,
+)
+from megatron_tpu.inference.engine import InferenceEngine, Request
+from megatron_tpu.inference.paging.pool import SCRATCH_PAGE
+from megatron_tpu.models import presets
+from megatron_tpu.models.params import init_params, param_specs
+from megatron_tpu.parallel.mesh import build_mesh
+from megatron_tpu.parallel.sharding import shard_tree
+from megatron_tpu.quant.collectives import (
+    cp_ring_comm_bytes, make_cp_comm, ring_permute,
+)
+
+CFG = presets.tiny(vocab_size=64, seq_length=64)
+PARAMS = init_params(CFG, jax.random.PRNGKey(0))
+
+
+# ---------------------------------------------------------------------------
+# striped page pool
+
+
+def test_striped_pool_ownership_and_striping():
+    # 8 pages over cp=2: rank 0 owns 1..3 (0 is scratch), rank 1 owns 4..7
+    pool = StripedPagePool(8, 2)
+    assert pool.pages_per_rank == 4
+    assert pool.free_pages_by_rank() == [3, 4]
+    pages = pool.alloc(4)  # logical 0..3 -> ranks 0,1,0,1
+    assert [pool.owner(p) for p in pages] == [0, 1, 0, 1]
+    assert pool.free_pages_by_rank() == [1, 2]
+    # logical_start continues the stripe mid-sequence
+    more = pool.alloc(2, logical_start=4)  # logical 4,5 -> ranks 0,1
+    assert [pool.owner(p) for p in more] == [0, 1]
+
+
+def test_striped_pool_all_or_nothing_per_rank():
+    pool = StripedPagePool(8, 2)
+    # rank 0 has 3 usable pages: an alloc needing 4 even-logical pages
+    # must fail WITHOUT draining rank 1
+    assert pool.alloc(7) is None
+    assert pool.free_pages_by_rank() == [3, 4]
+    # 6 logical pages = 3 per rank fits exactly
+    pages = pool.alloc(6)
+    assert pages is not None
+    assert pool.free_pages_by_rank() == [0, 1]
+    # release returns each page to its owner's free list
+    pool.release(pages)
+    assert pool.free_pages_by_rank() == [3, 4]
+
+
+def test_striped_pool_misuse_raises():
+    pool = StripedPagePool(8, 2)
+    with pytest.raises(ValueError):
+        StripedPagePool(9, 2)  # not divisible by cp
+    (p,) = pool.alloc(1)
+    pool.release([p])
+    with pytest.raises(ValueError):
+        pool.release([p])  # double release
+    # scratch page is never tracked
+    pool.retain([SCRATCH_PAGE])
+    pool.release([SCRATCH_PAGE])
+
+
+# ---------------------------------------------------------------------------
+# ring transport + byte model
+
+
+def test_ring_permute_dense_and_int8():
+    from jax.sharding import PartitionSpec as P
+
+    rt = build_mesh(ParallelConfig(context_parallel=2),
+                    devices=jax.devices()[:2])
+    perm = [(0, 1), (1, 0)]
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((2, 4, 32)), jnp.float32)
+
+    def run(mode):
+        body = lambda s: ring_permute(s, "context", perm, mode=mode,  # noqa: E731
+                                      chunk=16)
+        return jax.shard_map(body, mesh=rt.mesh, in_specs=(P("context"),),
+                             out_specs=P("context"), axis_names={"context"},
+                             check_vma=False)(x)
+
+    want = jnp.roll(x, 1, axis=0)  # shard r receives shard r-1's rows
+    np.testing.assert_array_equal(np.asarray(run("dense")), np.asarray(want))
+    got = np.asarray(run("int8"))
+    # per-chunk symmetric int8: bounded roundtrip error, not identity
+    err = np.max(np.abs(got - np.asarray(want)))
+    assert 0 < err <= np.max(np.abs(np.asarray(x))) / 127 + 1e-6
+    # the wire really moves int8 payloads
+    body = lambda s: ring_permute(s, "context", perm, mode="int8")  # noqa: E731
+    fn = jax.shard_map(body, mesh=rt.mesh, in_specs=(P("context"),),
+                       out_specs=P("context"), axis_names={"context"},
+                       check_vma=False)
+    assert "i8[" in str(jax.make_jaxpr(fn)(x))
+
+
+def test_cp_ring_byte_model():
+    rt = build_mesh(ParallelConfig(context_parallel=2),
+                    devices=jax.devices()[:2])
+    dense = make_cp_comm(rt.mesh, "dense", cfg=CFG)
+    int8 = make_cp_comm(rt.mesh, "int8", cfg=CFG)
+    b_dense = cp_ring_comm_bytes(CFG, dense, 2, 1)
+    b_int8 = cp_ring_comm_bytes(CFG, int8, 2, 1)
+    assert b_dense["dense"] == b_dense["compressed"]
+    assert b_int8["dense"] == b_dense["dense"]
+    assert 0 < b_int8["compressed"] < b_int8["dense"]
+    # the policy can pin cp_ring dense: byte model collapses to dense
+    gated = make_cp_comm(rt.mesh, "int8", cfg=CFG,
+                         policy={"cp_ring": False})
+    assert not gated.compresses() and gated.wire_mode() == "dense"
+    b_gated = cp_ring_comm_bytes(CFG, gated, 2, 1)
+    assert b_gated["compressed"] == b_gated["dense"]
+    # cp=1 / mode none build no transport
+    solo = build_mesh(ParallelConfig(), devices=jax.devices()[:1])
+    assert make_cp_comm(solo.mesh, "int8", cfg=CFG) is None
+    assert make_cp_comm(rt.mesh, "none", cfg=CFG) is None
+
+
+# ---------------------------------------------------------------------------
+# engine parity gates (real tiny model, cp=2 mesh)
+
+
+@pytest.fixture(scope="module")
+def cp_setup():
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 (fake) devices")
+    rt = build_mesh(ParallelConfig(context_parallel=2),
+                    devices=jax.devices()[:2])
+    sparams = shard_tree(rt, PARAMS, param_specs(CFG))
+    dense = InferenceEngine(CFG, PARAMS, num_slots=2, max_seq_len=64)
+    cpe = ContextParallelEngine(CFG, sparams, num_slots=2, max_seq_len=64,
+                                page_size=8, prefill_chunk=8, mesh=rt.mesh)
+    return rt, dense, cpe
+
+
+def _req(prompt, n=6):
+    return Request(prompt=np.asarray(prompt, np.int32), max_new_tokens=n)
+
+
+def _run(eng, prompt, n=6):
+    req = eng.submit(_req(prompt, n))
+    eng.run_until_idle()
+    assert req.error is None, req.error
+    return req
+
+
+def test_cp_parity_multichunk_ragged(cp_setup):
+    """A 13-token prompt: 2 chunks, neither aligned to page_size * cp —
+    the ragged tail crosses a shard boundary mid-page. Token-identical
+    with full logprob parity."""
+    _, dense, cpe = cp_setup
+    prompts = np.asarray([[3, 7, 11, 2, 9, 4, 1, 8, 5, 6, 2, 3, 7]],
+                         np.int32)
+    lengths = np.asarray([13], np.int32)
+    a = dense.generate(prompts, lengths, max_new_tokens=8, temperature=0.0)
+    b = cpe.generate(prompts, lengths, max_new_tokens=8, temperature=0.0)
+    np.testing.assert_array_equal(a.tokens, b.tokens)
+    np.testing.assert_allclose(a.logprobs, b.logprobs, rtol=1e-5, atol=1e-5)
+    assert cpe.stats["cp_ring_steps"] > 0
+
+
+def test_cp_parity_radix_hit_mid_shard(cp_setup):
+    """Two requests sharing a 3-page (24-token) prefix: the second
+    aliases cached pages whose stripe ends mid-shard (page 3 of the
+    follow-up starts on rank 1). Exactness must survive the alias."""
+    _, dense, cpe = cp_setup
+    prefix = list(range(5, 29))  # 24 tokens = 3 full pages
+    tail_a, tail_b = [30, 31], [40, 41, 42]
+    _run(cpe, prefix + tail_a)
+    hits0 = cpe.stats["prefix_hits"]
+    got = _run(cpe, prefix + tail_b)
+    assert cpe.stats["prefix_hits"] > hits0
+    want = _run(dense, prefix + tail_b)
+    assert got.generated == want.generated
+    np.testing.assert_allclose(got.logprobs, want.logprobs,
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(got.prompt_logprobs, want.prompt_logprobs,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_cp_parity_preempt_resume_mid_prefill(cp_setup):
+    """Preempt a CP request while its chunked prefill is mid-flight: the
+    resume recomputes through the striped pools and must finish with
+    exactly the tokens it would have produced without the preemption."""
+    _, dense, cpe = cp_setup
+    prompt = [int(t) for t in
+              np.random.default_rng(7).integers(1, 64, 40)]
+    req = cpe.submit(_req(prompt, 6))
+    cpe.step()  # admit + first chunk
+    cpe.step()  # second chunk (prompt is 5 chunks of 8)
+    assert cpe.prefill_queue.peek() is not None  # mid-prefill
+    pre0 = cpe.stats["preemptions"]
+    assert cpe._preempt_one()
+    assert cpe.stats["preemptions"] == pre0 + 1
+    cpe.run_until_idle()
+    assert req.error is None, req.error
+    want = _run(dense, prompt, 6)
+    assert req.generated == want.generated
+    np.testing.assert_allclose(req.logprobs, want.logprobs,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_cp_zero_decode_recompiles_after_warmup(cp_setup):
+    """Order-dependent on the parity tests above having driven real
+    traffic: the decode step must have compiled exactly once."""
+    _, _, cpe = cp_setup
+    assert cpe.stats["decode_recompiles"] == 0
+
+
+# ---------------------------------------------------------------------------
+# construction validation + host-side table building
+
+
+def test_cp_engine_rejects_bad_geometry(cp_setup):
+    rt, _, _ = cp_setup
+    with pytest.raises(ValueError, match="requires a mesh"):
+        ContextParallelEngine(CFG, PARAMS, mesh=None)
+    solo = build_mesh(ParallelConfig(), devices=jax.devices()[:1])
+    with pytest.raises(ValueError, match="cp == 1"):
+        ContextParallelEngine(CFG, PARAMS, mesh=solo.mesh)
+    with pytest.raises(ValueError, match="ring transport"):
+        ContextParallelEngine(CFG, PARAMS, mesh=rt.mesh,
+                              max_seq_len=64, cp_collectives="none")
+
+
+def test_cp_engine_rounds_pool_to_cp_multiple(cp_setup):
+    rt, _, _ = cp_setup
+    sparams = shard_tree(rt, PARAMS, param_specs(CFG))
+    eng = ContextParallelEngine(CFG, sparams, num_slots=2, max_seq_len=64,
+                                page_size=8, prefill_chunk=8, mesh=rt.mesh,
+                                num_pages=11)
+    assert eng.num_pages == 12 and eng.pool.pages_per_rank == 6
+
+
+def test_cp_loc_tables_striping_and_invariant(cp_setup):
+    _, _, cpe = cp_setup
+    npl, mpl = cpe._npl, cpe._mpl
+    row = np.zeros((1, cpe.max_pages), np.int32)
+    # logical 0 -> rank 0 local 1; logical 1 -> rank 1 local 2
+    row[0, 0], row[0, 1] = 1, npl + 2
+    loc = cpe._loc_tables(row)
+    assert loc.shape == (2, 1, mpl)
+    assert loc[0, 0, 0] == 1 and loc[1, 0, 0] == 2
+    # unallocated tail: local scratch on rank 0, sentinel elsewhere
+    assert loc[0, 0, 1] == 0 and loc[1, 0, 1] == npl
+    # a page on the wrong rank is a loud invariant violation
+    bad = np.zeros((1, cpe.max_pages), np.int32)
+    bad[0, 1] = 1  # logical 1 must live on rank 1, page 1 is rank 0's
+    with pytest.raises(AssertionError, match="striping invariant"):
+        cpe._loc_tables(bad)

@@ -202,7 +202,8 @@ def test_wire_bytes_model():
 
 def test_policy_defaults_and_derivation():
     pol = default_policy()
-    assert set(pol.enabled_sites()) == {"attn_out", "mlp_out", "logits"}
+    assert set(pol.enabled_sites()) == {"attn_out", "mlp_out", "logits",
+                                        "cp_ring"}
     derived = policy_from_exposure({"all-reduce": 0.8, "all-gather": 0.1},
                                    threshold=0.25)
     assert derived.enabled("attn_out") and derived.enabled("mlp_out")

@@ -105,7 +105,8 @@ def _diff_manifests(name_a: str, name_b: str) -> int:
         nb = vb["count"] if vb else 0
         wa = va.get("total_wire_bytes", 0) if va else 0
         wb = vb.get("total_wire_bytes", 0) if vb else 0
-        tag = (" [q]" if (vb or {}).get("compressed") else "")
+        tag = (" [q]" if ((va or {}).get("compressed")
+                          or (vb or {}).get("compressed")) else "")
         print(f"{k:<{w}}  {na:>5}>{nb:<5} "
               f"{_fmt_bytes(wa):>10}>{_fmt_bytes(wb):<10}{tag}")
     ja, jb = a.get("jaxpr", {}), b.get("jaxpr", {})

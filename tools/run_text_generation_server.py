@@ -125,6 +125,23 @@ def extra_args(parser):
                         "exposed fractions): sites whose collective time "
                         "hides under compute stay dense. Default: "
                         "compress every site")
+    g.add_argument("--serve_context_parallel", action="store_true",
+                   help="context-parallel serving (docs/serving.md): "
+                        "shard each sequence's paged KV over the mesh's "
+                        "context axis and ring-attend across the shards "
+                        "— long-context prompts whose KV exceeds one "
+                        "device. Needs --serve_kv_paging and "
+                        "--context_parallel >= 2; greedy output stays "
+                        "token-identical to single-host paged serving")
+    g.add_argument("--serve_cp_collectives",
+                   choices=("dense", "int8", "fp8"), default="dense",
+                   help="transport for the CP ring-attention hops "
+                        "(quant/collectives.py ring_permute): int8/fp8 "
+                        "compress the rotating attention partials; the "
+                        "per-position log-sum-exp row stays fp32")
+    g.add_argument("--serve_cp_comm_policy", default=None,
+                   help="site-policy JSON gating the cp_ring site "
+                        "(tools/trace_report.py --emit-comm-policy)")
     g.add_argument("--serve_profile_dir", default=None,
                    help="output dir for POST /admin/profile on-demand "
                         "captures (default runs/serve_profile); read the "
@@ -291,7 +308,10 @@ def main(argv=None):
                draft_cfg=draft_cfg, draft_params=draft_params,
                profile_dir=args.serve_profile_dir,
                compress_collectives=args.serve_compress_collectives,
-               comm_policy=args.serve_comm_policy)
+               comm_policy=args.serve_comm_policy,
+               cp_serving=args.serve_context_parallel,
+               cp_collectives=args.serve_cp_collectives,
+               cp_comm_policy=args.serve_cp_comm_policy)
 
 
 if __name__ == "__main__":
