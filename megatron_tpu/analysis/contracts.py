@@ -112,6 +112,19 @@ CONFIGS: Dict[str, Callable[[], Any]] = {
     # shard_map; see moe_ep2)
     "decode_tp2_cp2": lambda: _targets().cp_paged_decode_step_target(
         "decode_tp2_cp2"),
+    # the overlapped-ring decode schedule at tp=1 x cp=2: hop l+1's
+    # ppermute issues before hop l's merge (double-buffered carry).
+    # The ledger keys on op counts, not order — this manifest proves
+    # the overlap moves EXACTLY the serial ring's hops and bytes (the
+    # perf win is exposed-time only; tools/trace_report.py measures it)
+    "decode_cp2_overlap": lambda: _targets().cp_paged_decode_step_target(
+        "decode_cp2_overlap", tp=1, cp=2, overlap=True),
+    # 2D CP geometry at cp=4 = cp_seq 2 x cp_head 2 (tp=1): per layer a
+    # head-scatter all_to_all + head-gather all_gather inside each
+    # subgroup, and cp_seq-1 ppermute hops ACROSS subgroups at
+    # 1/subgroup payload — the topology-aware ledger (ATTENTION2D/TASP)
+    "decode_cp4_2d": lambda: _targets().cp_paged_decode_step_target(
+        "decode_cp4_2d", tp=1, cp=4, geometry="2d", subgroup=2),
     # context-parallel chunked prefill at cp=2: one [1, C] prompt chunk
     # scatter-written into the striped pools + ring-attended — the
     # distributed long-prompt prefill ledger

@@ -293,16 +293,26 @@ def tp_decode_step_target(name: str = "decode_tp2_dense",
 
 def cp_paged_decode_step_target(name: str = "decode_tp2_cp2",
                                 tp: int = 2, cp: int = 2,
-                                num_slots: int = 4) -> AuditTarget:
+                                num_slots: int = 4,
+                                geometry: str = "ring",
+                                subgroup: int = 0,
+                                overlap: bool = True) -> AuditTarget:
     """The context-parallel serving engine's batched decode step on a
     TP x CP mesh: per-layer ring attention over the sequence-striped
     page pools — (cp-1) ppermute hops per layer moving the normalized
     (out, lse) partials — composed with the explicit TP collectives
     (attn_out/mlp_out psum + the vocab-parallel logits all_gather).
     The manifest is the dense CP ring ledger the compressed cp_ring
-    policy diffs against. jaxpr-only: like moe_ep2, compiling the
-    full-manual shard_map output back into GSPMD context RET_CHECK-
-    crashes the baked XLA (compat.py), so can_compile=False."""
+    policy diffs against.
+
+    geometry/subgroup/overlap pin the topology-aware variants:
+    `decode_cp2_overlap` (flat ring, double-buffered hop schedule —
+    its ledger must EQUAL the serial ring's, proving the overlap moves
+    no extra bytes) and `decode_cp4_2d` (cp = cp_seq x cp_head: head
+    all-to-all + all_gather inside each subgroup, ppermute hops only
+    across subgroups at 1/subgroup payload). jaxpr-only: like moe_ep2,
+    compiling the full-manual shard_map output back into GSPMD context
+    RET_CHECK-crashes the baked XLA (compat.py), so can_compile=False."""
     from megatron_tpu.config import ParallelConfig
     from megatron_tpu.inference.context_parallel import ContextParallelEngine
     from megatron_tpu.models.params import init_params, param_specs
@@ -317,7 +327,8 @@ def cp_paged_decode_step_target(name: str = "decode_tp2_cp2",
     eng = ContextParallelEngine(
         cfg, sparams, num_slots=num_slots, max_seq_len=cfg.seq_length,
         page_size=8, prefill_chunk=16, mesh=rt.mesh, force_donate=True,
-        compress_collectives="dense", cp_collectives="dense")
+        compress_collectives="dense", cp_collectives="dense",
+        cp_geometry=geometry, cp_subgroup=subgroup, cp_overlap=overlap)
     N = num_slots
     args = (
         _sds(sparams),

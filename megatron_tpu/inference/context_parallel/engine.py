@@ -12,8 +12,11 @@ table rows — and restructures only the device side:
     ([cp, rows, pages_per_rank], sharded on dim 0) instead of the flat
     global row, which routes the per-layer attention through the
     ring-attention island (ring_kv.paged_ring_attention): each rank
-    attends its own sequence stripe, cp-1 ``ppermute`` hops merge the
-    normalized partials;
+    attends its own sequence stripe and the normalized partials merge
+    under the selected geometry — the flat overlapped ring (cp-1
+    ``ppermute`` hops, hop l+1 issued before hop l's merge) or the 2d
+    cp_seq x cp_head factorization (head all-to-all inside a
+    `cp_subgroup`-sized group, cp_seq-1 ring hops across groups);
   * the hop transport is quant/collectives.CpComm — dense fp32 or
     policy-gated int8/fp8 (site "cp_ring"), composable with the
     existing TP compressed collectives on a TP x CP mesh.
@@ -59,7 +62,10 @@ class ContextParallelEngine(PagedInferenceEngine):
                  comm_policy=None,
                  comm_chunk: int = 32,
                  cp_collectives: str = "dense",
-                 cp_comm_policy=None):
+                 cp_comm_policy=None,
+                 cp_geometry: str = "ring",
+                 cp_subgroup: int = 0,
+                 cp_overlap: bool = True):
         if mesh is None:
             raise ValueError(
                 "ContextParallelEngine requires a mesh with a non-trivial "
@@ -73,7 +79,10 @@ class ContextParallelEngine(PagedInferenceEngine):
         # set BEFORE super().__init__: the inherited step builders close
         # over cp_comm, and _fresh_caches rounds the pool to cp shards
         self.cp_comm = make_cp_comm(mesh, cp_collectives, cfg=cfg,
-                                    policy=cp_comm_policy, chunk=comm_chunk)
+                                    policy=cp_comm_policy, chunk=comm_chunk,
+                                    geometry=cp_geometry,
+                                    subgroup=cp_subgroup,
+                                    overlap=cp_overlap)
         if self.cp_comm is None:
             raise ValueError(
                 f"cp_collectives={cp_collectives!r} disables the ring "
@@ -111,7 +120,11 @@ class ContextParallelEngine(PagedInferenceEngine):
                 cfg, self.cp_comm, 1, self.prefill_chunk),
         }
         self.stats.update({"cp_ring_steps": 0, "cp_comm_dense_bytes": 0,
-                           "cp_comm_compressed_bytes": 0})
+                           "cp_comm_compressed_bytes": 0,
+                           "cp_comm_a2a_dense_bytes": 0,
+                           "cp_comm_a2a_compressed_bytes": 0,
+                           "cp_admission_blocked": 0})
+        self._cp_dry_shards: tuple = ()
         m = self.metrics
         self._m_cp_ring = m.counter(
             "engine_cp_ring_steps_total",
@@ -122,9 +135,21 @@ class ContextParallelEngine(PagedInferenceEngine):
         self._m_cp_comp = m.counter(
             "engine_cp_comm_compressed_bytes_total",
             "wire bytes the CP ring hops move at the configured mode")
+        self._m_cp_a2a_dense = m.counter(
+            "engine_cp_a2a_dense_bytes_total",
+            "wire bytes the 2d geometry's head a2a legs would move dense")
+        self._m_cp_a2a_comp = m.counter(
+            "engine_cp_a2a_compressed_bytes_total",
+            "wire bytes the 2d geometry's head a2a legs move at the "
+            "configured mode")
         self._m_cp_shard_free = m.gauge(
             "engine_cp_shard_pages_free",
             "free pages in each CP rank's pool shard",
+            label_names=("shard",))
+        self._m_cp_blocked = m.counter(
+            "engine_cp_admission_blocked_total",
+            "page allocations blocked by an exhausted CP pool shard "
+            "(striped-pool pressure, distinct from queue depth)",
             label_names=("shard",))
         self._set_shard_gauges()
 
@@ -159,13 +184,45 @@ class ContextParallelEngine(PagedInferenceEngine):
         """Striped allocation with per-rank-aware eviction: a failed
         alloc means SOME rank's shard is dry, so evict LRU cache-only
         pages (whatever ranks hold them) and retry until the striped
-        grab fits or eviction runs dry."""
+        grab fits or eviction runs dry. A final failure is attributed
+        to the dry shard(s): counter + journal + the distinct 503
+        detail (_overload_detail), so operators can tell striped-pool
+        pressure from ordinary queue depth."""
         pages = self.pool.alloc(n, logical_start)
         while pages is None and self.prefix_cache.evict(max(n, 1)) > 0:
             pages = self.pool.alloc(n, logical_start)
         if pages is not None:
             self._m_pages_free.set(self.pool.free_pages)
-        return pages
+            self._cp_dry_shards = ()
+            return pages
+        need = [0] * self.cp
+        for j in range(n):
+            need[(logical_start + j) % self.cp] += 1
+        free = self.pool.free_pages_by_rank()
+        dry = tuple(r for r in range(self.cp) if need[r] > free[r])
+        self.stats["cp_admission_blocked"] += 1
+        for r in dry:
+            self._m_cp_blocked.inc(shard=str(r))
+        if dry != self._cp_dry_shards:
+            # once per episode, not per retried tick
+            from megatron_tpu.telemetry import journal as _journal
+
+            j = _journal.get_global_journal()
+            if j is not None:
+                j.emit("cp_admission_blocked", shards=list(dry),
+                       need=need, free_by_rank=list(free), pages=n)
+        self._cp_dry_shards = dry
+        return None
+
+    def _overload_detail(self) -> str:
+        """Queue-full rejections name the dry shard(s) when striped-pool
+        exhaustion — not decode throughput — is what's stalling
+        admission (the 503 detail fleet operators key on)."""
+        if self._cp_dry_shards:
+            shards = ",".join(str(r) for r in self._cp_dry_shards)
+            return (f"cp shard(s) {shards} exhausted (striped KV pool "
+                    "pressure); ")
+        return ""
 
     # ----- device tables ---------------------------------------------------
 
@@ -213,13 +270,19 @@ class ContextParallelEngine(PagedInferenceEngine):
         cp_pair = self._cp_bytes_for.get(id(bytes_pair))
         if cp_pair is None:
             return
-        hops = (self.cp - 1) * self.cfg.num_layers
+        hops = self.cp_comm.ring_hops() * self.cfg.num_layers
         self.stats["cp_ring_steps"] += hops
         self.stats["cp_comm_dense_bytes"] += cp_pair["dense"]
         self.stats["cp_comm_compressed_bytes"] += cp_pair["compressed"]
         self._m_cp_ring.inc(hops)
         self._m_cp_dense.inc(cp_pair["dense"])
         self._m_cp_comp.inc(cp_pair["compressed"])
+        if cp_pair.get("a2a_dense"):
+            self.stats["cp_comm_a2a_dense_bytes"] += cp_pair["a2a_dense"]
+            self.stats["cp_comm_a2a_compressed_bytes"] += (
+                cp_pair["a2a_compressed"])
+            self._m_cp_a2a_dense.inc(cp_pair["a2a_dense"])
+            self._m_cp_a2a_comp.inc(cp_pair["a2a_compressed"])
 
     def _set_shard_gauges(self) -> None:
         for r, free in enumerate(self.pool.free_pages_by_rank()):

@@ -6,10 +6,30 @@ K/V rows into the LOCAL pool shard (each rank owns the pages of its
 sequence stripe — logical page l lives on rank ``l % cp``), (2) runs
 the exact masked attention of ops/attention.py against the local
 stripe only, producing a normalized (out, lse) partial, and (3) merges
-the cp partials with cp-1 ``ppermute`` ring hops and the
-ring-attention merge algebra (ops/ring_attention._merge_normalized).
+the cp partials with the ring-attention merge algebra
+(ops/ring_attention._merge_normalized) under one of two geometries:
+
+  * "ring" — cp-1 ``ppermute`` hops around the flat context axis. The
+    schedule is OVERLAPPED by default (CpComm.overlap): hop l+1's
+    permute of the (o, lse) partial is issued BEFORE the merge compute
+    over hop l's arrival, which is legal because the permute chain
+    depends only on previous permute results, never on the merges —
+    the accumulator hangs off each arrival separately. Same hop count,
+    same wire bytes, numerics identical to the serial schedule; an
+    async backend (TPU collective-permute-start/done) can run hop l+1
+    under hop l's merge instead of exposing it.
+  * "2d" — cp = cp_seq x cp_head (ATTENTION2D): a tiled head
+    all-to-all inside each cp_head-sized subgroup trades full-head
+    partials for ITS head slice of every member's partial (site
+    "cp_a2a"), the members' stripes merge locally, then cp_seq-1 ring
+    hops ACROSS subgroups (1/cp_head the payload) merge the rest, and
+    an intra-subgroup all_gather restores the full head dim — TASP's
+    topology-aware placement: the expensive ring traverses the slow
+    fabric tier once, the chatty legs stay node-local.
+
 The hop transport is quant/collectives.ring_permute — dense fp32 or
-policy-gated int8/fp8 (site "cp_ring").
+policy-gated int8/fp8 (site "cp_ring"); the 2d legs ride
+grouped_all_to_all / grouped_all_gather (site "cp_a2a").
 
 Mask semantics mirror ops/attention.py exactly so the CP engine stays
 token-identical to the dense one:
@@ -34,7 +54,81 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from megatron_tpu.ops.ring_attention import _merge_normalized
-from megatron_tpu.quant.collectives import ring_permute
+from megatron_tpu.quant.collectives import (
+    grouped_all_gather, grouped_all_to_all, ring_permute,
+)
+
+
+def _ring_hop(cpc, o, lse, perm):
+    """One ring hop: the o partial over the (optionally compressed)
+    cp_ring transport, the lse row always dense fp32."""
+    no = ring_permute(o, cpc.axis, perm, mode=cpc.wire_mode(),
+                      chunk=cpc.chunk)
+    nl = jax.lax.ppermute(lse, cpc.axis, perm)
+    return no, nl
+
+
+def _ring_merge(cpc, o, lse, perm, hops):
+    """Merge `hops` ring arrivals into the local partial.
+
+    Serial schedule: permute -> merge -> permute -> ... (each hop's
+    send waits for the previous merge in program order). Overlapped
+    schedule (cpc.overlap): hop l+1's permute is issued BEFORE hop l's
+    merge — valid because ``cur`` chains only through permutes and the
+    accumulator hangs off each arrival separately, so the reorder is
+    numerics-identical with the same hop count and wire bytes; it just
+    stops the merge compute from serializing the collective chain."""
+    acc_o, acc_lse = o, lse
+    if hops <= 0:
+        return acc_o, acc_lse
+    if not cpc.overlap:
+        cur_o, cur_lse = o, lse
+        for _ in range(hops):
+            cur_o, cur_lse = _ring_hop(cpc, cur_o, cur_lse, perm)
+            acc_o, acc_lse = _merge_normalized((acc_o, acc_lse),
+                                               cur_o, cur_lse)
+        return acc_o, acc_lse
+    nxt_o, nxt_lse = _ring_hop(cpc, o, lse, perm)
+    for hop in range(hops):
+        cur_o, cur_lse = nxt_o, nxt_lse
+        if hop + 1 < hops:
+            nxt_o, nxt_lse = _ring_hop(cpc, cur_o, cur_lse, perm)
+        acc_o, acc_lse = _merge_normalized((acc_o, acc_lse),
+                                           cur_o, cur_lse)
+    return acc_o, acc_lse
+
+
+def _merge_2d(cpc, o, lse):
+    """The 2d-geometry merge: head scatter inside the subgroup, local
+    merge of the members' stripes, overlapped ring across subgroups at
+    1/subgroup the payload, head gather. Every rank ends with the full
+    [B, S, Hq, D] result (replicated, like the flat ring)."""
+    cp, g = cpc.cp, cpc.subgroup
+    sg = cp // g
+    bsz, s_len, hq, d = o.shape
+    groups = [list(range(i * g, (i + 1) * g)) for i in range(sg)]
+    a2a_mode = cpc.a2a_wire_mode()
+    # head scatter: member h of each subgroup ends with head slice h of
+    # every member's partial, stacked in member order on a leading dim
+    o_st = grouped_all_to_all(o, cpc.axis, split_axis=2, concat_axis=0,
+                              groups=groups, mode=a2a_mode,
+                              chunk=cpc.chunk)
+    l_st = jax.lax.all_to_all(lse, cpc.axis, split_axis=2,
+                              concat_axis=0, tiled=True,
+                              axis_index_groups=groups)
+    o_st = o_st.reshape(g, bsz, s_len, hq // g, d)
+    l_st = l_st.reshape(g, bsz, s_len, hq // g)
+    acc_o, acc_lse = o_st[0], l_st[0]
+    for m in range(1, g):
+        acc_o, acc_lse = _merge_normalized((acc_o, acc_lse),
+                                           o_st[m], l_st[m])
+    # ring only across subgroups: rank (s, h) -> (s+1, h)
+    perm = [(r, (r + g) % cp) for r in range(cp)]
+    acc_o, acc_lse = _ring_merge(cpc, acc_o, acc_lse, perm, sg - 1)
+    # head gather: the members' full-sequence head slices reassemble
+    return grouped_all_gather(acc_o, cpc.axis, gather_axis=2,
+                              groups=groups, mode=a2a_mode,
+                              chunk=cpc.chunk)
 
 
 def paged_ring_attention(cpc, q, k_new, v_new, kv_cache, loc_tables,
@@ -142,16 +236,12 @@ def paged_ring_attention(cpc, q, k_new, v_new, kv_cache, loc_tables,
         o = o.reshape(B, S, Hq, D)
         lse = lse.reshape(B, S, Hq)
 
-        # -- ring merge: cp-1 hops, all ranks end with the full result --
-        perm = [(i, (i + 1) % cp) for i in range(cp)]
-        acc_o, acc_lse = o, lse
-        cur_o, cur_lse = o, lse
-        for _ in range(cp - 1):
-            cur_o = ring_permute(cur_o, axis, perm, mode=cpc.wire_mode(),
-                                 chunk=cpc.chunk)
-            cur_lse = jax.lax.ppermute(cur_lse, axis, perm)
-            acc_o, acc_lse = _merge_normalized((acc_o, acc_lse),
-                                               cur_o, cur_lse)
+        # -- merge: all ranks end with the full result ------------------
+        if cpc.geometry == "2d":
+            acc_o = _merge_2d(cpc, o, lse)
+        else:
+            perm = [(i, (i + 1) % cp) for i in range(cp)]
+            acc_o, _ = _ring_merge(cpc, o, lse, perm, cp - 1)
         return acc_o.astype(qx.dtype), kp, vp
 
     shard = P(axis)

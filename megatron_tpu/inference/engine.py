@@ -701,7 +701,7 @@ class InferenceEngine:
                 req.overloaded = True
                 req._finish(
                     f"engine queue full ({self.max_queue} waiting); "
-                    "retry later")
+                    + self._overload_detail() + "retry later")
                 self.stats["rejected"] += 1
                 self._m_rejected.inc()
                 return req
@@ -709,6 +709,13 @@ class InferenceEngine:
             self._m_queue.set(len(self._queue))
             self._cv.notify_all()
         return req
+
+    def _overload_detail(self) -> str:
+        """Extra cause text for queue-full rejections — subclasses with
+        a richer admission model (the CP engine's striped pools) name
+        WHAT is actually blocking, so the 503 detail distinguishes
+        resource exhaustion from plain queue depth."""
+        return ""
 
     @property
     def num_active(self) -> int:

@@ -194,10 +194,24 @@ def _build_and_serve(spec: Dict[str, Any]) -> None:
         print(f"replica loaded weights iter {weights_version} "
               f"from {spec['load']}", flush=True)
 
+    # context-parallel replica: build a context-only mesh of cp devices.
+    # cp may be < the local device count — with cp_lanes > 1 one host
+    # runs several independent CP engine lanes (CP x DP) and the
+    # router-visible load is the lane sum (scrape.replica_load).
+    mesh = None
+    if spec.get("cp_serving"):
+        import numpy as np
+        from jax.sharding import Mesh
+
+        cp = int(spec.get("cp", 2))
+        mesh = Mesh(np.array(jax.devices()[:cp]).reshape(cp),
+                    axis_names=("context",))
+
     run_server(
         cfg, params, tokenizer,
         host=spec.get("host", "127.0.0.1"),
         port=int(spec.get("port", 0)),
+        mesh=mesh,
         engine_slots=int(spec.get("engine_slots", 2)),
         engine_max_seq_len=spec.get("max_seq_len"),
         engine_max_queue=spec.get("max_queue"),
@@ -221,6 +235,16 @@ def _build_and_serve(spec: Dict[str, Any]) -> None:
         # replica spec serves compressed without a new entry point
         compress_collectives=spec.get("compress_collectives", "none"),
         comm_policy=spec.get("comm_policy"),
+        # context-parallel serving spec keys (docs/serving.md "CP geometry
+        # and overlap"): geometry/subgroup pick the 2D factorization,
+        # cp_lanes > 1 packs multiple CP groups on one replica host
+        cp_serving=bool(spec.get("cp_serving", False)),
+        cp_collectives=spec.get("cp_collectives", "dense"),
+        cp_comm_policy=spec.get("cp_comm_policy"),
+        cp_geometry=spec.get("cp_geometry", "ring"),
+        cp_subgroup=int(spec.get("cp_subgroup", 0)),
+        cp_overlap=bool(spec.get("cp_overlap", True)),
+        cp_lanes=int(spec.get("cp_lanes", 1)),
         port_file=spec.get("port_file"),
         reload_dir=spec.get("reload_dir") or spec.get("load"),
         weights_version=weights_version,

@@ -165,6 +165,19 @@ def sample_value(samples: Samples, name: str,
     return default
 
 
+def sample_sum(samples: Samples, name: str,
+               default: float = float("nan"), **labels) -> float:
+    """Sum of every sample of `name` matching `labels` — how a
+    multi-lane replica (CP x DP: one gauge series per engine lane)
+    rolls up to one number. `default` when no sample matches."""
+    total, seen = 0.0, False
+    for got, value in samples.get(name, ()):
+        if _match(got, labels):
+            total += value
+            seen = True
+    return total if seen else default
+
+
 def histogram_percentile(samples: Samples, name: str, q: float,
                          **labels) -> float:
     """q-quantile from `name`'s cumulative `_bucket` series — same
@@ -245,10 +258,13 @@ def merged_histogram_percentile(parts: List[Samples], name: str, q: float,
 def replica_load(samples: Samples,
                  default: float = float("inf")) -> float:
     """Dispatch load score off the engine gauges PR 3 added: busy slots +
-    queued requests. Missing gauges (scrape raced server startup) score as
-    `default` so the router prefers replicas it can actually see."""
-    active = sample_value(samples, "engine_slots_active")
-    queued = sample_value(samples, "engine_queue_depth")
+    queued requests, SUMMED across label sets — a CP x DP replica
+    exposes one series per engine lane (lane="0", "1", ...) and its
+    load is the fleet-visible total. Missing gauges (scrape raced
+    server startup) score as `default` so the router prefers replicas
+    it can actually see."""
+    active = sample_sum(samples, "engine_slots_active")
+    queued = sample_sum(samples, "engine_queue_depth")
     if math.isnan(active) and math.isnan(queued):
         return default
     return ((0.0 if math.isnan(active) else active)

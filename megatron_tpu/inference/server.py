@@ -110,6 +110,42 @@ class ServiceDrainingError(RuntimeError):
     requests answer 503 + Retry-After so the router re-routes them."""
 
 
+def _lane_meshes(mesh, cp_lanes: int) -> list:
+    """CP x DP device carving: every lane gets its own context-only
+    cp-sized mesh over a distinct device group (the serving mesh's
+    devices first, then the host's remaining devices). The incoming
+    mesh must not SHARD params — tensor/pipe/expert > 1 refuse, since
+    a lane could not replicate its params copy with a plain
+    device_put; a `data` axis is pure replication for serving (the CLI
+    mesh builder parks unused devices there) and is re-carved into
+    lanes."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from megatron_tpu.parallel.mesh import AXIS_CONTEXT
+
+    shape = dict(mesh.shape)
+    cp = shape.get(AXIS_CONTEXT, 1)
+    sharded = {a: n for a, n in shape.items()
+               if n > 1 and a not in (AXIS_CONTEXT, "data")}
+    if sharded:
+        raise ValueError(
+            "cp_lanes > 1 needs a context-only mesh (no tensor/pipe/"
+            f"expert sharding); got {shape} — a tensor-sharded lane "
+            "cannot replicate its params copy with a plain device_put")
+    pool = list(mesh.devices.flat)
+    seen = {d.id for d in pool}
+    pool += [d for d in jax.devices() if d.id not in seen]
+    need = cp_lanes * cp
+    if len(pool) < need:
+        raise ValueError(
+            f"cp_lanes={cp_lanes} x cp={cp} needs {need} devices; "
+            f"only {len(pool)} visible")
+    return [Mesh(np.array(pool[i * cp:(i + 1) * cp]).reshape((cp,)),
+                 (AXIS_CONTEXT,))
+            for i in range(cp_lanes)]
+
+
 class GenerationService:
     def __init__(self, cfg: ModelConfig, params: Any, tokenizer,
                  mesh=None, forward_fn=None, kv_cache_int8=False,
@@ -133,6 +169,10 @@ class GenerationService:
                  cp_serving: bool = False,
                  cp_collectives: str = "dense",
                  cp_comm_policy: Optional[str] = None,
+                 cp_geometry: str = "ring",
+                 cp_subgroup: int = 0,
+                 cp_overlap: bool = True,
+                 cp_lanes: int = 1,
                  peers: Optional[list] = None):
         """mesh + forward_fn serve sharded models: the mesh becomes
         ambient around generation (GSPMD handles tp/cp), forward_fn is the
@@ -179,7 +219,21 @@ class GenerationService:
         with context >= 2; greedy output stays token-identical to the
         single-host paged engine. cp_collectives ("dense"|"int8"|"fp8")
         picks the ring-hop transport; cp_comm_policy is a site-policy
-        JSON gating the "cp_ring" site.
+        JSON gating the "cp_ring" and "cp_a2a" sites.
+
+        cp_geometry (--serve_cp_geometry): "ring" is the flat 1D
+        sequence ring; "2d" factors the context axis into
+        cp_seq x cp_head (cp_subgroup = cp_head, the node-local device
+        count) — head all-to-all inside the subgroup, ring hops only
+        across subgroups (docs/serving.md "CP geometry and overlap").
+        cp_overlap picks the overlapped ring schedule (default; serial
+        kept for A/B trace capture). cp_lanes > 1 (CP x DP): one host
+        runs that many INDEPENDENT CP engine lanes, each over its own
+        cp-sized device group with its own KV pool and queue; requests
+        dispatch to the least-loaded lane and /metrics exposes one
+        series per lane (lane="0", ...) that the fleet router's load
+        scrape sums. Lanes need a context-only mesh (tp == 1) and do
+        not compose with peers (migration handoff) or /admin/reload.
 
         peers: base URLs of sibling replicas (http://host:port). A drain
         (SIGTERM grace or /admin/drain) HANDS OFF in-flight and queued
@@ -244,6 +298,20 @@ class GenerationService:
             "KV-state migration wire bytes (manifest cost model)",
             label_names=("direction",))
         self.engine = None
+        self.engines: list = []
+        self.cp_lanes = int(cp_lanes)
+        if self.cp_lanes < 1:
+            raise ValueError(f"cp_lanes must be >= 1, got {cp_lanes}")
+        if self.cp_lanes > 1:
+            if not cp_serving:
+                raise ValueError(
+                    "cp_lanes > 1 is the CP x DP geometry — it needs "
+                    "--serve_context_parallel")
+            if self.peers:
+                raise ValueError(
+                    "cp_lanes > 1 does not compose with migration "
+                    "handoff peers yet — run one lane per replica to "
+                    "keep handoff")
         if speculative and not engine_slots:
             raise ValueError(
                 "speculative decoding runs inside the continuous-batching "
@@ -269,17 +337,45 @@ class GenerationService:
                     raise ValueError(
                         "context-parallel serving supports neither int8 "
                         "KV pools nor speculative decoding")
-                self.engine = ContextParallelEngine(
-                    cfg, params, num_slots=engine_slots,
-                    max_seq_len=engine_max_seq_len,
-                    page_size=page_size, prefill_chunk=prefill_chunk,
-                    num_pages=num_pages,
-                    vocab_size=tokenizer.vocab_size, mesh=mesh,
-                    metrics=self.metrics, max_queue=engine_max_queue,
-                    compress_collectives=compress_collectives,
-                    comm_policy=comm_policy,
-                    cp_collectives=cp_collectives,
-                    cp_comm_policy=cp_comm_policy)
+                def _cp_engine(lane_mesh, lane_params, lane_metrics):
+                    return ContextParallelEngine(
+                        cfg, lane_params, num_slots=engine_slots,
+                        max_seq_len=engine_max_seq_len,
+                        page_size=page_size, prefill_chunk=prefill_chunk,
+                        num_pages=num_pages,
+                        vocab_size=tokenizer.vocab_size, mesh=lane_mesh,
+                        metrics=lane_metrics, max_queue=engine_max_queue,
+                        compress_collectives=compress_collectives,
+                        comm_policy=comm_policy,
+                        cp_collectives=cp_collectives,
+                        cp_comm_policy=cp_comm_policy,
+                        cp_geometry=cp_geometry,
+                        cp_subgroup=cp_subgroup,
+                        cp_overlap=cp_overlap)
+
+                if self.cp_lanes > 1:
+                    from jax.sharding import NamedSharding, PartitionSpec
+
+                    from megatron_tpu.telemetry.metrics import (
+                        LabeledRegistryView,
+                    )
+
+                    # every lane mesh is context-only (the serving mesh
+                    # may carry a replication-only data axis the lanes
+                    # re-carve), so each lane replicates its own params
+                    # copy onto its device group
+                    for i, lane_mesh in enumerate(
+                            _lane_meshes(mesh, self.cp_lanes)):
+                        lane_params = jax.device_put(
+                            params, NamedSharding(lane_mesh,
+                                                  PartitionSpec()))
+                        self.engines.append(_cp_engine(
+                            lane_mesh, lane_params,
+                            LabeledRegistryView(self.metrics,
+                                                lane=str(i))))
+                    self.engine = self.engines[0]
+                else:
+                    self.engine = _cp_engine(mesh, params, self.metrics)
             elif kv_paging:
                 from megatron_tpu.inference.paging import PagedInferenceEngine
 
@@ -306,16 +402,20 @@ class GenerationService:
                     speculative=spec_cfg,
                     compress_collectives=compress_collectives,
                     comm_policy=comm_policy)
-            self.engine.start()
+            if not self.engines:
+                self.engines = [self.engine]
+            for eng in self.engines:
+                eng.start()
         if not (warmup and self.engine is not None):
             # no deferred warmup: the first request pays the compile (the
             # pre-fleet behavior) and readiness is green from the start
             self._warmed.set()
 
     def shutdown(self) -> None:
-        """Stop the engine's step-loop thread (no-op without an engine)."""
-        if self.engine is not None:
-            self.engine.stop()
+        """Stop every engine lane's step-loop thread (no-op without an
+        engine)."""
+        for eng in self.engines:
+            eng.stop()
 
     # ----- fleet control plane (docs/serving.md "Fleet") -------------------
 
@@ -335,9 +435,10 @@ class GenerationService:
             import numpy as np
 
             t0 = time.monotonic()
-            self.engine.generate(np.array([[1]], np.int32),
-                                 np.array([1], np.int32), max_new_tokens=2)
-            self._journal("serve_warmup",
+            for eng in self.engines:
+                eng.generate(np.array([[1]], np.int32),
+                             np.array([1], np.int32), max_new_tokens=2)
+            self._journal("serve_warmup", lanes=len(self.engines),
                           wall_s=round(time.monotonic() - t0, 3))
         self._warmed.set()
 
@@ -350,9 +451,10 @@ class GenerationService:
                         "reloading": self.reloading}
         ok = detail["warmed"] and not self.draining and not self.reloading
         if self.engine is not None:
-            alive = (self.engine._thread is None
-                     or self.engine._thread.is_alive())
-            stalled = self.engine.stalled(self.stall_threshold_s)
+            alive = all(e._thread is None or e._thread.is_alive()
+                        for e in self.engines)
+            stalled = any(e.stalled(self.stall_threshold_s)
+                          for e in self.engines)
             detail["step_loop_alive"] = alive
             detail["stalled"] = stalled
             ok = ok and alive and not stalled
@@ -383,9 +485,11 @@ class GenerationService:
             deadline = time.monotonic() + timeout_s
             if peers and self.engine is not None:
                 self.migrate_out(peers, timeout_s=timeout_s)
-            drained = (self.engine.wait_idle(
-                           timeout=max(deadline - time.monotonic(), 0.001))
-                       if self.engine is not None else True)
+            drained = all(
+                eng.wait_idle(
+                    timeout=max(deadline - time.monotonic(), 0.001))
+                for eng in self.engines) if self.engine is not None \
+                else True
             if drained:
                 # even with an engine, beam-search and scoring requests
                 # run one-shot under self.lock — a drain that ignored
@@ -709,11 +813,22 @@ class GenerationService:
                "weights_version": self.weights_version}
         if self.engine is not None:
             out["engine"] = dict(self.engine.stats)
+            if len(self.engines) > 1:
+                out["lanes"] = [dict(e.stats) for e in self.engines]
         return out
 
     def _mesh_scope(self):
         return (jax.sharding.set_mesh(self.mesh) if self.mesh is not None
                 else contextlib.nullcontext())
+
+    def _pick_lane(self):
+        """Least-loaded engine lane by busy slots + queue depth — the
+        same score replica_load computes fleet-side from the lane
+        gauges, so in-host and cross-host dispatch agree."""
+        if len(self.engines) <= 1:
+            return self.engine
+        return min(self.engines,
+                   key=lambda e: e.num_active + len(e._queue))
 
     def handle(self, req: dict) -> dict:
         if self.draining:
@@ -753,6 +868,9 @@ class GenerationService:
         # the one-shot path serializes whole requests and makes the mesh
         # ambient here (the engine's driver thread scopes its own)
         use_engine = self.engine is not None and n > 0
+        # CP x DP: dispatch this request to the least-loaded engine lane
+        # (the in-host analogue of the fleet router's replica_load)
+        engine = self._pick_lane() if use_engine else None
         # per-request deadline (engine path): a request may SHORTEN the
         # server default (--serve_request_timeout) but never extend past
         # it — the operator bound caps the router's retry worst case and
@@ -789,7 +907,7 @@ class GenerationService:
                 random_seed=int(req.get("random_seed", 0)),
                 forward_fn=self.forward_fn,
                 kv_cache_int8=self.kv_cache_int8,
-                engine=self.engine if use_engine else None,
+                engine=engine,
                 deadline_s=deadline_s if use_engine else None,
                 spec=spec)
             out = {"text": texts, "segments": segments}
@@ -1036,6 +1154,10 @@ def run_server(cfg: ModelConfig, params: Any, tokenizer,
                cp_serving: bool = False,
                cp_collectives: str = "dense",
                cp_comm_policy: Optional[str] = None,
+               cp_geometry: str = "ring",
+               cp_subgroup: int = 0,
+               cp_overlap: bool = True,
+               cp_lanes: int = 1,
                peers: Optional[list] = None) -> None:
     """Serve until killed. SIGTERM/SIGINT triggers a graceful drain
     (mirroring DistributedSignalHandler): stop admitting (503 +
@@ -1071,6 +1193,10 @@ def run_server(cfg: ModelConfig, params: Any, tokenizer,
                                 cp_serving=cp_serving,
                                 cp_collectives=cp_collectives,
                                 cp_comm_policy=cp_comm_policy,
+                                cp_geometry=cp_geometry,
+                                cp_subgroup=cp_subgroup,
+                                cp_overlap=cp_overlap,
+                                cp_lanes=cp_lanes,
                                 peers=peers)
     server = ThreadingHTTPServer((host, port), make_handler(service))
     bound_port = server.server_address[1]
@@ -1130,7 +1256,12 @@ def run_server(cfg: ModelConfig, params: Any, tokenizer,
             + (", paged KV + prefix cache" if kv_paging else "")
             + (f", context-parallel KV (cp="
                f"{getattr(service.engine, 'cp', 0)}, "
-               f"ring {getattr(getattr(service.engine, 'cp_comm', None), 'mode', '?')})"
+               f"{cp_geometry}"
+               + (f" sub={cp_subgroup}" if cp_geometry == "2d" else "")
+               + f" {'overlapped' if cp_overlap else 'serial'} "
+               f"{getattr(getattr(service.engine, 'cp_comm', None), 'mode', '?')}"
+               + (f", {cp_lanes} lanes" if cp_lanes > 1 else "")
+               + ")"
                if cp_serving else "")
             + (f", speculative ({speculative}, k={spec_k})"
                if speculative else "")

@@ -112,11 +112,22 @@ def make_tp_comm(mesh, mode: str, cfg=None, policy=None,
                   sites=sites)
 
 
+#: the CP attention geometries --serve_cp_geometry exposes: "ring" is
+#: the flat 1D sequence ring (cp-1 hops); "2d" factors the context axis
+#: into cp_seq x cp_head (ATTENTION2D): ulysses-style head all-to-all
+#: inside a `subgroup`-sized group, ring hops only ACROSS subgroups —
+#: TASP's topology-aware placement (the expensive ring traverses the
+#: slow fabric tier once, at 1/subgroup the payload).
+CP_GEOMETRIES = ("ring", "2d")
+
+
 @dataclasses.dataclass(frozen=True)
 class CpComm:
     """One engine's context-parallel communication plan: the mesh axis
-    the KV pages are striped over and the transport precision of the
-    ring-attention hop (site "cp_ring"). Static at engine build, like
+    the KV pages are striped over, the transport precision of the
+    ring-attention hop (site "cp_ring") and of the 2d geometry's head
+    all-to-all legs (site "cp_a2a"), the attention geometry, and the
+    ring schedule (overlapped vs serial). Static at engine build, like
     TpComm — compiled into the decode/chunk steps."""
 
     mesh: object                 # jax.sharding.Mesh
@@ -125,6 +136,10 @@ class CpComm:
     chunk: int = 32
     axis: str = AXIS_CONTEXT
     compress_ring: bool = True   # the policy's "cp_ring" decision
+    geometry: str = "ring"       # "ring" | "2d"
+    subgroup: int = 1            # cp_head under "2d" (1 under "ring")
+    overlap: bool = True         # hop l+1 issued before hop l's merge
+    compress_a2a: bool = True    # the policy's "cp_a2a" decision
 
     def compresses(self) -> bool:
         return self.compress_ring and self.mode in ("int8", "fp8")
@@ -134,16 +149,42 @@ class CpComm:
         low-bit mode only when the policy enabled the cp_ring site."""
         return self.mode if self.compresses() else "dense"
 
+    def a2a_compresses(self) -> bool:
+        return self.compress_a2a and self.mode in ("int8", "fp8")
+
+    def a2a_wire_mode(self) -> str:
+        """The mode the 2d head scatter/gather legs run with: low-bit
+        only when the policy enabled the cp_a2a site."""
+        return self.mode if self.a2a_compresses() else "dense"
+
+    def seq_groups(self) -> int:
+        """cp_seq: how many sequence-stripe subgroups the ring visits
+        (== cp under the flat ring geometry)."""
+        return self.cp // self.subgroup
+
+    def ring_hops(self) -> int:
+        """Ring hops per layer per forward: cp-1 flat, cp_seq-1 under
+        2d (the intra-subgroup merge rides the a2a legs instead)."""
+        return self.seq_groups() - 1
+
 
 def make_cp_comm(mesh, mode: str, cfg=None, policy=None,
-                 chunk: int = 32) -> Optional[CpComm]:
+                 chunk: int = 32, geometry: str = "ring",
+                 subgroup: int = 0,
+                 overlap: bool = True) -> Optional[CpComm]:
     """Build the engine's CpComm, or None when context parallelism is a
     no-op (mode "none", no mesh, or a trivial context axis). policy:
-    same knob as make_tp_comm — only its "cp_ring" site is consulted
-    (the TP sites belong to TpComm)."""
+    same knob as make_tp_comm — only its "cp_ring" and "cp_a2a" sites
+    are consulted (the TP sites belong to TpComm). geometry "2d"
+    requires `subgroup` (cp_head) >= 2 dividing both cp and the query
+    head count — each subgroup member owns heads/subgroup heads through
+    the merge."""
     if mode not in MODES:
         raise ValueError(f"cp_collectives must be one of {MODES}, "
                          f"got {mode!r}")
+    if geometry not in CP_GEOMETRIES:
+        raise ValueError(f"cp geometry must be one of {CP_GEOMETRIES}, "
+                         f"got {geometry!r}")
     if mode == "none" or mesh is None:
         return None
     cp = dict(mesh.shape).get(AXIS_CONTEXT, 1)
@@ -155,9 +196,35 @@ def make_cp_comm(mesh, mode: str, cfg=None, policy=None,
             "use 'int8'")
     if chunk < 1:
         raise ValueError(f"comm chunk must be >= 1, got {chunk}")
+    if geometry == "2d":
+        if subgroup < 2:
+            raise ValueError(
+                "cp geometry '2d' needs a subgroup (cp_head) >= 2 — "
+                f"got {subgroup}; pick the node-local device count "
+                "(--serve_cp_subgroup)")
+        if cp % subgroup:
+            raise ValueError(
+                f"cp geometry '2d': subgroup {subgroup} does not divide "
+                f"the context axis {cp} (cp = cp_seq x cp_head needs an "
+                "exact factorization)")
+        if cfg is not None and cfg.num_attention_heads % subgroup:
+            raise ValueError(
+                f"cp geometry '2d': query head count "
+                f"{cfg.num_attention_heads} is not divisible by the "
+                f"subgroup {subgroup} — the head all-to-all gives each "
+                "member heads/subgroup heads")
+    else:
+        if subgroup not in (0, 1):
+            raise ValueError(
+                f"cp geometry 'ring' takes no subgroup (got {subgroup}); "
+                "select --serve_cp_geometry 2d to factor the axis")
+        subgroup = 1
     pol = resolve_policy(policy)
     return CpComm(mesh=mesh, cp=cp, mode=mode, chunk=int(chunk),
-                  compress_ring=pol.enabled("cp_ring"))
+                  compress_ring=pol.enabled("cp_ring"),
+                  geometry=geometry, subgroup=int(subgroup),
+                  overlap=bool(overlap),
+                  compress_a2a=pol.enabled("cp_a2a"))
 
 
 def _validate_cfg(cfg, tp: int, sites) -> None:
@@ -242,6 +309,50 @@ def ring_permute(x: jnp.ndarray, axis_name: str, perm,
     q, s = quantize_chunked(x, c, mode)
     q = jax.lax.ppermute(q, axis_name, perm)
     s = jax.lax.ppermute(s, axis_name, perm)
+    return dequantize_chunked(q, s, x.dtype)
+
+
+def grouped_all_to_all(x: jnp.ndarray, axis_name: str, split_axis: int,
+                       concat_axis: int, groups,
+                       mode: str = "dense",
+                       chunk: int = 32) -> jnp.ndarray:
+    """Subgroup-scoped tiled all_to_all inside a shard_map body — the
+    2d CP geometry's head-scatter leg (site "cp_a2a"): each member of a
+    `groups` row trades its split_axis slices with its peers only.
+    Optionally low-bit on the wire (payload + fp32 scales, quantized
+    along the last axis, like ring_permute)."""
+    if mode in ("none", "dense"):
+        return jax.lax.all_to_all(x, axis_name, split_axis=split_axis,
+                                  concat_axis=concat_axis, tiled=True,
+                                  axis_index_groups=groups)
+    c = effective_chunk(x.shape[-1], chunk)
+    q, s = quantize_chunked(x, c, mode)
+    q = jax.lax.all_to_all(q, axis_name, split_axis=split_axis,
+                           concat_axis=concat_axis, tiled=True,
+                           axis_index_groups=groups)
+    s = jax.lax.all_to_all(s, axis_name, split_axis=split_axis,
+                           concat_axis=concat_axis, tiled=True,
+                           axis_index_groups=groups)
+    return dequantize_chunked(q, s, x.dtype)
+
+
+def grouped_all_gather(x: jnp.ndarray, axis_name: str, gather_axis: int,
+                       groups, mode: str = "dense",
+                       chunk: int = 32) -> jnp.ndarray:
+    """Subgroup-scoped tiled all_gather — the 2d CP geometry's
+    head-gather leg (site "cp_a2a"): reassembles the full head dim from
+    the members' head slices after the cross-subgroup ring. Same wire
+    treatment as grouped_all_to_all (quantized along the LAST axis, so
+    a non-last gather_axis still compresses)."""
+    if mode in ("none", "dense"):
+        return jax.lax.all_gather(x, axis_name, axis=gather_axis,
+                                  tiled=True, axis_index_groups=groups)
+    c = effective_chunk(x.shape[-1], chunk)
+    q, s = quantize_chunked(x, c, mode)
+    q = jax.lax.all_gather(q, axis_name, axis=gather_axis, tiled=True,
+                           axis_index_groups=groups)
+    s = jax.lax.all_gather(s, axis_name, axis=gather_axis, tiled=True,
+                           axis_index_groups=groups)
     return dequantize_chunked(q, s, x.dtype)
 
 
@@ -390,30 +501,58 @@ def forward_comm_bytes(cfg, tpc: Optional[TpComm], batch: int,
 
 def cp_ring_comm_bytes(cfg, cpc: Optional[CpComm], batch: int,
                        seq: int) -> Dict[str, int]:
-    """Per-forward wire bytes of the CP ring-attention hops for a
-    [batch, seq] token pass: {"dense", "compressed"}. Each of the cp-1
-    hops per layer permutes the normalized partial output (fp32
+    """Per-forward wire bytes of the CP attention merge for a
+    [batch, seq] token pass: {"dense", "compressed"} are the ring-hop
+    rows (cp-1 hops per layer flat; cp_seq-1 hops at 1/subgroup the
+    head payload under the 2d geometry); {"a2a_dense",
+    "a2a_compressed"} are the 2d geometry's intra-subgroup head
+    scatter/gather legs (site "cp_a2a" — zero under the flat ring).
+    Each ring hop permutes the normalized partial output (fp32
     [batch, seq, heads, head_dim]) plus its log-sum-exp row (fp32
-    [batch, seq, heads] — never compressed: it feeds the merge's exp/log
-    directly). Same wire model as the jaxpr auditor, so the golden
-    manifests and the live counters agree. Zero when cpc is None."""
-    out = {"dense": 0, "compressed": 0}
+    [batch, seq, heads] — never compressed: it feeds the merge's
+    exp/log directly). Same wire model as the jaxpr auditor, so the
+    golden manifests and the live counters agree. Zero when cpc is
+    None."""
+    out = {"dense": 0, "compressed": 0, "a2a_dense": 0,
+           "a2a_compressed": 0}
     if cpc is None:
         return out
+    g = cpc.subgroup
     rows = batch * seq * cfg.num_attention_heads
-    o_payload = rows * cfg.head_dim * 4
-    lse_payload = rows * 4
-    hops = (cpc.cp - 1) * cfg.num_layers
+    ring_rows = batch * seq * (cfg.num_attention_heads // g)
+    o_payload = ring_rows * cfg.head_dim * 4
+    lse_payload = ring_rows * 4
+    hops = cpc.ring_hops() * cfg.num_layers
     dense_hop = (wire_bytes_per_call("ppermute", o_payload, cpc.cp)
                  + wire_bytes_per_call("ppermute", lse_payload, cpc.cp))
     out["dense"] = dense_hop * hops
     if not cpc.compresses():
         out["compressed"] = out["dense"]
+    else:
+        c = effective_chunk(cfg.head_dim, cpc.chunk)
+        q = ring_rows * cfg.head_dim          # int8/fp8: 1 byte/elt
+        s = ring_rows * (cfg.head_dim // c) * 4   # fp32 scales
+        comp_hop = (wire_bytes_per_call("ppermute", q + s, cpc.cp)
+                    + wire_bytes_per_call("ppermute", lse_payload,
+                                          cpc.cp))
+        out["compressed"] = comp_hop * hops
+    if cpc.geometry != "2d":
+        return out
+    # the a2a legs, per layer: scatter moves the full-head partial
+    # (o + lse) inside the subgroup; after the ring, gather reassembles
+    # the full head dim from the members' slices. lse rides dense.
+    o_full = rows * cfg.head_dim * 4
+    lse_full = rows * 4
+    legs = (wire_bytes_per_call("all_to_all", o_full + lse_full, g)
+            + wire_bytes_per_call("all_gather", o_full, g))
+    out["a2a_dense"] = legs * cfg.num_layers
+    if not cpc.a2a_compresses():
+        out["a2a_compressed"] = out["a2a_dense"]
         return out
     c = effective_chunk(cfg.head_dim, cpc.chunk)
-    q = rows * cfg.head_dim                   # int8/fp8: 1 byte/elt
-    s = rows * (cfg.head_dim // c) * 4        # fp32 scales
-    comp_hop = (wire_bytes_per_call("ppermute", q + s, cpc.cp)
-                + wire_bytes_per_call("ppermute", lse_payload, cpc.cp))
-    out["compressed"] = comp_hop * hops
+    q = rows * cfg.head_dim
+    s = rows * (cfg.head_dim // c) * 4
+    comp_legs = (wire_bytes_per_call("all_to_all", q + s + lse_full, g)
+                 + wire_bytes_per_call("all_gather", q + s, g))
+    out["a2a_compressed"] = comp_legs * cfg.num_layers
     return out

@@ -15,6 +15,11 @@ fraction — the Flash Communication number — and
   * ``cp_ring`` — the context-parallel ring-attention hop
     (collective-permute at runtime, inference/context_parallel/):
     compressed when the collective-permute exposed fraction clears it.
+  * ``cp_a2a`` — the 2D CP geometry's intra-subgroup head
+    scatter/gather legs (all-to-all at runtime, ring_kv._merge_2d):
+    compressed when the all-to-all exposed fraction clears it —
+    measured SEPARATELY from the ring's collective-permute, because the
+    two run on different fabric tiers (node-local vs cross-node).
 
 ``tools/trace_report.py --emit-comm-policy OUT.json`` writes the derived
 policy; serving loads it back with ``--serve_comm_policy OUT.json``.
@@ -41,12 +46,17 @@ SITE_COLLECTIVES: Dict[str, str] = {
     "mlp_out": "all-reduce",
     "logits": "all-gather",
     "cp_ring": "collective-permute",
+    "cp_a2a": "all-to-all",
 }
 
 #: the subset of sites living inside the TENSOR-parallel comm plan
-#: (TpComm): "cp_ring" belongs to the context-parallel ring transport
-#: (CpComm) and must never reach TpComm's width validation.
+#: (TpComm): "cp_ring" / "cp_a2a" belong to the context-parallel
+#: transport (CpComm) and must never reach TpComm's width validation.
 TP_SITES = ("attn_out", "mlp_out", "logits")
+
+#: the context-parallel transport's sites (CpComm): the ring hop and,
+#: under the 2d geometry, the intra-subgroup head all-to-all legs.
+CP_SITES = ("cp_ring", "cp_a2a")
 
 #: no-measurement default: compress everything (the static Flash-
 #: Communication stance; a trace-derived policy prunes hidden ones)

@@ -269,6 +269,88 @@ class MetricsRegistry:
         return "\n".join(out) + ("\n" if out else "")
 
 
+class _BoundCollector:
+    """A collector with a constant label set pre-applied (the lane tag
+    of a CP x DP engine lane). Observation methods proxy through with
+    the constant labels merged in; reads do the same."""
+
+    def __init__(self, collector: _Collector,
+                 constant: Dict[str, str]):
+        self._c = collector
+        self._constant = dict(constant)
+
+    def _merge(self, labels: Dict) -> Dict:
+        overlap = set(labels) & set(self._constant)
+        if overlap:
+            raise ValueError(
+                f"metric {self._c.name}: label(s) {sorted(overlap)} are "
+                "pinned by the registry view and cannot be passed "
+                "per-call")
+        out = dict(self._constant)
+        out.update(labels)
+        return out
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        self._c.inc(amount, **self._merge(labels))
+
+    def set(self, value: float, **labels) -> None:
+        self._c.set(value, **self._merge(labels))
+
+    def observe(self, value: float, **labels) -> None:
+        self._c.observe(value, **self._merge(labels))
+
+    def value(self, **labels) -> float:
+        return self._c.value(**self._merge(labels))
+
+    def count(self, **labels) -> int:
+        return self._c.count(**self._merge(labels))
+
+
+class LabeledRegistryView:
+    """A registry facade that stamps constant labels onto every
+    collector it hands out — how the CP x DP engine lanes share one
+    host registry while keeping per-lane series: every lane asks for
+    the same metric names, the real collectors carry an extra "lane"
+    label dimension, and the exposition (and the fleet router's load
+    scrape, which SUMS across label sets) sees each lane separately."""
+
+    def __init__(self, registry: "MetricsRegistry", **constant_labels):
+        if not constant_labels:
+            raise ValueError("LabeledRegistryView needs at least one "
+                             "constant label")
+        self._reg = registry
+        self._constant = {k: str(v) for k, v in constant_labels.items()}
+        self._extra = tuple(sorted(self._constant))
+
+    def _names(self, label_names) -> tuple:
+        return tuple(label_names) + self._extra
+
+    def counter(self, name: str, help: str = "",
+                label_names=()) -> _BoundCollector:
+        return _BoundCollector(
+            self._reg.counter(name, help, self._names(label_names)),
+            self._constant)
+
+    def gauge(self, name: str, help: str = "",
+              label_names=()) -> _BoundCollector:
+        return _BoundCollector(
+            self._reg.gauge(name, help, self._names(label_names)),
+            self._constant)
+
+    def histogram(self, name: str, help: str = "", label_names=(),
+                  buckets=DEFAULT_BUCKETS) -> _BoundCollector:
+        return _BoundCollector(
+            self._reg.histogram(name, help, self._names(label_names),
+                                buckets=buckets),
+            self._constant)
+
+    def get(self, name: str) -> Optional[_Collector]:
+        return self._reg.get(name)
+
+    def render(self) -> str:
+        return self._reg.render()
+
+
 _default: Optional[MetricsRegistry] = None
 _default_lock = threading.Lock()
 

@@ -75,6 +75,11 @@ def test_bench_main_emits_one_json_line(monkeypatch):
                           prompt_len=48, prefill_chunk=16, new_tokens=2,
                           reps=1, cfg=tiny_headline()))
     monkeypatch.setattr(
+        bench, "serve_cp_overlap_bench",
+        functools.partial(bench.serve_cp_overlap_bench,
+                          prompt_len=24, prefill_chunk=16, new_tokens=2,
+                          cfg=tiny_headline(), trace=False))
+    monkeypatch.setattr(
         bench, "train_attention_bwd_bench",
         functools.partial(bench.train_attention_bwd_bench, s=128, d=32,
                           iters=1))
@@ -85,7 +90,7 @@ def test_bench_main_emits_one_json_line(monkeypatch):
     # full (non-quick) runs: the serving metric lines + the preemption
     # notice-budget line + the flash-bwd gate line, then the headline
     # LAST (the only positional contract the driver relies on)
-    assert len(lines) == 9
+    assert len(lines) == 10
     serve = json.loads(lines[0])
     assert serve["metric"] == "serve_decode_throughput_toks_per_s"
     assert set(serve) >= {"metric", "value", "unit", "vs_baseline"}
@@ -126,18 +131,30 @@ def test_bench_main_emits_one_json_line(monkeypatch):
     assert lctx["detail"]["greedy_tokens_match_single_host"], lctx
     assert lctx["detail"]["decode_recompiles_after_warmup"] == 0
     assert lctx["detail"]["cp_ring_steps"] > 0
-    slo = json.loads(lines[5])
+    ovl = json.loads(lines[5])
+    assert ovl["metric"] == "serve_cp_overlap"
+    assert "error" not in ovl, ovl
+    # the deterministic gates: the overlapped schedule's committed
+    # golden carries EXACTLY the serial ring's ppermute rows (same
+    # hops, same bytes — only exposed time moves), greedy stays token-
+    # identical both ways, and the runtime ring counters agree
+    assert ovl["detail"]["golden_hops_bytes_match_serial_ring"], ovl
+    assert all(ovl["detail"]["greedy_tokens_match_single_host"].values())
+    assert ovl["detail"]["ring_steps_equal"], ovl
+    assert ovl["detail"]["ring_bytes_equal"], ovl
+    assert ovl["detail"]["decode_recompiles_after_warmup"] == 0
+    slo = json.loads(lines[6])
     assert slo["metric"] == "serve_slo_offered_load"
     assert "error" not in slo, slo
     # every request must complete (a lost request zeroes the line) and
     # the percentile block must be populated
     assert slo["value"] > 0 and slo["detail"]["failed"] == 0, slo
     assert set(slo["detail"]["ttft_s"]) == {"p50", "p95", "p99"}
-    pre = json.loads(lines[6])
+    pre = json.loads(lines[7])
     assert pre["metric"] == "preempt_save_latency_ms"
     assert "error" not in pre, pre
     assert pre["value"] > 0
-    fb = json.loads(lines[7])
+    fb = json.loads(lines[8])
     assert fb["metric"] == "train_attention_bwd_speedup"
     assert "error" not in fb, fb
     # the deterministic gate: the gradient jaxpr contains the template's
@@ -230,7 +247,8 @@ def test_bench_probe_retries_until_backend_up(monkeypatch):
     # test_bench_main_emits_one_json_line + the slow speedup gate)
     for leg in ("serving_engine_bench", "serve_prefix_cache_bench",
                 "serve_speculative_bench", "serve_compressed_comm_bench",
-                "serve_longctx_prefill_bench", "serve_slo_bench"):
+                "serve_longctx_prefill_bench", "serve_cp_overlap_bench",
+                "serve_slo_bench"):
         monkeypatch.setattr(
             bench, leg,
             lambda deadline, _leg=leg, **kw: {"metric": _leg, "value": 0.0})

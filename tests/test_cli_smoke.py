@@ -58,6 +58,22 @@ def test_comm_report_cli_check_cp():
     assert "comm contracts: OK" in out.stdout
 
 
+@pytest.mark.slow  # subprocess retrace of two CP decode configs (~8s);
+# test_analysis gates both in-process in tier-1
+def test_comm_report_cli_check_cp_geometry():
+    # the topology-aware manifests (ISSUE 20): the overlapped-ring
+    # ledger (must equal the serial ring's hop rows — overlap moves
+    # exposed time, not bytes) and the 2D cp=4 geometry's a2a +
+    # cross-subgroup ring ledger
+    out = _run([os.path.join("tools", "comm_report.py"), "--check",
+                "--config", "decode_cp2_overlap",
+                "--config", "decode_cp4_2d"],
+               env_extra={"XLA_FLAGS":
+                          "--xla_force_host_platform_device_count=8"})
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "comm contracts: OK" in out.stdout
+
+
 def test_comm_report_cli_diff():
     # the dense-vs-compressed reduction as one command (ISSUE 15
     # satellite) — reads golden JSON only, no jax import
@@ -77,11 +93,18 @@ def test_trace_report_cli_emit_comm_policy(tmp_path):
     assert out.returncode == 0, out.stdout + out.stderr
     doc = json.loads(pol.read_text())
     # the fixture's all-reduce is 87% exposed => psum sites compress;
-    # no all-gather / collective-permute was measured => the logits and
-    # cp_ring sites stay dense
+    # no all-gather / collective-permute / all-to-all was measured =>
+    # the logits, cp_ring, and cp_a2a sites stay dense
     assert doc["sites"] == {"attn_out": True, "mlp_out": True,
-                            "logits": False, "cp_ring": False}
+                            "logits": False, "cp_ring": False,
+                            "cp_a2a": False}
     assert doc["exposure"]["all-reduce"] > 0.8
+    # per-site exposed fractions: each site reports ITS collective
+    # kind's measured exposure — the ring (collective-permute) and a2a
+    # legs are separable in a 2D-geometry trace
+    assert doc["site_exposure"]["attn_out"] == doc["exposure"]["all-reduce"]
+    assert doc["site_exposure"]["cp_ring"] == 0.0
+    assert doc["site_exposure"]["cp_a2a"] == 0.0
 
 
 def test_trace_report_cli_help_and_fixture():

@@ -162,6 +162,15 @@ def test_scrape_diff_and_merge():
         {"engine_slots_active": [({}, 2.0)],
          "engine_queue_depth": [({}, 3.0)]}) == 5.0
     assert scrape.replica_load({}) == float("inf")
+    # a CP x DP replica exposes one series per engine lane: the load
+    # score SUMS lanes (sample_sum), not first-match-wins
+    assert scrape.replica_load(
+        {"engine_slots_active": [({"lane": "0"}, 2.0),
+                                 ({"lane": "1"}, 1.0)],
+         "engine_queue_depth": [({"lane": "0"}, 3.0)]}) == 6.0
+    assert scrape.sample_sum(
+        {"m": [({"lane": "0"}, 1.0), ({"lane": "1"}, 2.5)]}, "m") == 3.5
+    assert scrape.sample_sum({}, "m", default=0.0) == 0.0
 
 
 def test_slo_trace_deterministic_and_report_math():

@@ -203,11 +203,15 @@ def test_wire_bytes_model():
 def test_policy_defaults_and_derivation():
     pol = default_policy()
     assert set(pol.enabled_sites()) == {"attn_out", "mlp_out", "logits",
-                                        "cp_ring"}
+                                        "cp_ring", "cp_a2a"}
     derived = policy_from_exposure({"all-reduce": 0.8, "all-gather": 0.1},
                                    threshold=0.25)
     assert derived.enabled("attn_out") and derived.enabled("mlp_out")
     assert not derived.enabled("logits")
+    # cp_a2a keys on all-to-all exposure, independently of cp_ring
+    a2a = policy_from_exposure({"all-to-all": 0.5,
+                                "collective-permute": 0.1}, threshold=0.25)
+    assert a2a.enabled("cp_a2a") and not a2a.enabled("cp_ring")
     # absent op kinds (never measured / fully hidden) stay dense
     none = policy_from_exposure({}, threshold=0.25)
     assert none.enabled_sites() == ()

@@ -93,6 +93,41 @@ def test_registry_get_or_create_and_conflicts():
         a.inc(1, nope="v")  # undeclared label
 
 
+def test_labeled_registry_view():
+    """The CP x DP lane facade: constant labels stamped onto every
+    collector a lane registers, so N lanes share one host registry
+    while the exposition keeps per-lane series."""
+    from megatron_tpu.telemetry.metrics import LabeledRegistryView
+
+    r = MetricsRegistry()
+    lane0 = LabeledRegistryView(r, lane="0")
+    lane1 = LabeledRegistryView(r, lane="1")
+    c0 = lane0.counter("engine_steps_total", "steps")
+    c1 = lane1.counter("engine_steps_total", "steps")
+    c0.inc(3)
+    c1.inc(5)
+    assert c0.value() == 3.0 and c1.value() == 5.0
+    # per-call labels merge with the pinned one
+    g0 = lane0.gauge("engine_free", "free", label_names=("shard",))
+    g0.set(7, shard="1")
+    assert g0.value(shard="1") == 7.0
+    text = r.render()
+    assert 'engine_steps_total{lane="0"} 3' in text
+    assert 'engine_steps_total{lane="1"} 5' in text
+    assert 'engine_free{lane="0",shard="1"} 7' in text or \
+        'engine_free{shard="1",lane="0"} 7' in text
+    # passing the pinned label per-call is a collision, not a silent
+    # override
+    with pytest.raises(ValueError, match="pinned"):
+        c0.inc(lane="9")
+    with pytest.raises(ValueError):
+        LabeledRegistryView(r)  # a view without labels is pointless
+    # histograms proxy too (the latency series the router percentiles)
+    h = lane1.histogram("engine_tick_seconds", "tick")
+    h.observe(0.5)
+    assert 'engine_tick_seconds_count{lane="1"} 1' in r.render()
+
+
 # ---------------------------------------------------------------------------
 # event journal
 

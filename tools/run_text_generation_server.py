@@ -140,8 +140,37 @@ def extra_args(parser):
                         "compress the rotating attention partials; the "
                         "per-position log-sum-exp row stays fp32")
     g.add_argument("--serve_cp_comm_policy", default=None,
-                   help="site-policy JSON gating the cp_ring site "
-                        "(tools/trace_report.py --emit-comm-policy)")
+                   help="site-policy JSON gating the cp_ring and cp_a2a "
+                        "sites (tools/trace_report.py --emit-comm-policy)")
+    g.add_argument("--serve_cp_geometry", choices=("ring", "2d"),
+                   default="ring",
+                   help="context-axis attention geometry (docs/serving.md "
+                        "'CP geometry and overlap'): 'ring' rotates KV "
+                        "partials around all cp ranks; '2d' factors cp = "
+                        "cp_seq x cp_head — a head all-to-all inside each "
+                        "--serve_cp_subgroup-sized subgroup (intra-node "
+                        "bandwidth), ring hops only ACROSS subgroups at "
+                        "1/subgroup payload (topology-aware placement)")
+    g.add_argument("--serve_cp_subgroup", type=int, default=0,
+                   help="subgroup size (cp_head) for --serve_cp_geometry "
+                        "2d: must divide both cp and the model's query-"
+                        "head count. 0/1 for ring geometry")
+    g.add_argument("--serve_cp_overlap", choices=("on", "off"),
+                   default="on",
+                   help="ring-hop schedule: 'on' issues hop l+1's "
+                        "collective-permute before merging hop l's stripe "
+                        "(double-buffered carry, comm hides under merge "
+                        "compute); 'off' keeps the serial permute->merge "
+                        "chain. Identical numerics, hop count and wire "
+                        "bytes either way — only exposed comm time moves")
+    g.add_argument("--serve_cp_lanes", type=int, default=1,
+                   help="run this many independent CP engine lanes on one "
+                        "host (CP x DP): lane i gets its own cp-sized "
+                        "device group and engine; the in-process "
+                        "dispatcher routes each request to the least-"
+                        "loaded lane and /metrics carries a lane=\"i\" "
+                        "label per series. Needs cp * lanes <= local "
+                        "device count and a context-only mesh")
     g.add_argument("--serve_profile_dir", default=None,
                    help="output dir for POST /admin/profile on-demand "
                         "captures (default runs/serve_profile); read the "
@@ -311,7 +340,11 @@ def main(argv=None):
                comm_policy=args.serve_comm_policy,
                cp_serving=args.serve_context_parallel,
                cp_collectives=args.serve_cp_collectives,
-               cp_comm_policy=args.serve_cp_comm_policy)
+               cp_comm_policy=args.serve_cp_comm_policy,
+               cp_geometry=args.serve_cp_geometry,
+               cp_subgroup=args.serve_cp_subgroup,
+               cp_overlap=args.serve_cp_overlap == "on",
+               cp_lanes=args.serve_cp_lanes)
 
 
 if __name__ == "__main__":

@@ -217,7 +217,15 @@ def main(argv=None) -> int:
         policy = policy_mod.policy_from_exposure(
             exposure, threshold=args.exposed_threshold,
             source=f"trace:{args.trace}")
-        doc = dict(policy.to_dict(), exposure=exposure)
+        # per-site exposed fractions: each policy site keyed by ITS
+        # collective kind — collective-permute (cp_ring) and all-to-all
+        # (cp_a2a) report separately, so a 2D-geometry trace shows which
+        # leg is actually exposed
+        site_exposure = {
+            site: exposure.get(kind, 0.0)
+            for site, kind in policy_mod.SITE_COLLECTIVES.items()}
+        doc = dict(policy.to_dict(), exposure=exposure,
+                   site_exposure=site_exposure)
         with open(args.emit_comm_policy, "w", encoding="utf-8") as f:
             json.dump(doc, f, indent=1)
             f.write("\n")
